@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Benchmark driver — prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric: LeNet-5 MNIST training throughput (samples/sec/chip) —
+BASELINE.json configs[0]. The reference publishes no numbers
+(BASELINE.md), so vs_baseline is reported against a self-measured
+nd4j-era CPU figure recorded here as REFERENCE_CPU_SAMPLES_PER_SEC once
+available; until then vs_baseline = 1.0 and the absolute number is the
+tracked quantity.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+# Self-baselined: no published reference numbers exist (BASELINE.md). This
+# constant tracks OUR first-round measurement so later rounds report progress.
+REFERENCE_CPU_SAMPLES_PER_SEC = None  # filled once a reference-side run exists
+FIRST_ROUND_SAMPLES_PER_SEC = None  # set after round 1 records BENCH_r1.json
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.models.lenet import build_lenet5
+    from deeplearning4j_tpu.datasets.fetchers import load_mnist
+
+    batch = 512
+    warmup_steps = 3
+    bench_steps = 30
+
+    net = build_lenet5()
+    x, y = load_mnist(train=True, num_examples=batch * 4)
+    xs = [x[i * batch : (i + 1) * batch] for i in range(4)]
+    ys = [y[i * batch : (i + 1) * batch] for i in range(4)]
+
+    # warmup (compile)
+    for i in range(warmup_steps):
+        net.fit(xs[i % 4], ys[i % 4])
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for i in range(bench_steps):
+        net.fit(xs[i % 4], ys[i % 4])
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * bench_steps / dt
+    vs = (
+        samples_per_sec / REFERENCE_CPU_SAMPLES_PER_SEC
+        if REFERENCE_CPU_SAMPLES_PER_SEC
+        else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "lenet5_mnist_train_throughput",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

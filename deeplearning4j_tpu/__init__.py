@@ -1,0 +1,28 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A brand-new framework with the capability surface of Deeplearning4J
+(reference: ieee820/deeplearning4j v0.4-rc3.9, see /root/repo/SURVEY.md),
+re-designed idiomatically for TPU on JAX/XLA:
+
+- whole-training-step ``jax.jit`` compilation instead of op-by-op dispatch
+  (reference: per-op JVM->JavaCPP->native calls, SURVEY.md section 3.1),
+- ``jax`` autodiff instead of hand-written ``Layer.backpropGradient`` chains,
+- ``lax.scan`` recurrence instead of Java per-timestep loops
+  (reference: LSTMHelpers.java:132,273),
+- ``jax.sharding.Mesh`` + collectives (psum/pmean over ICI) instead of the
+  Spark ParameterAveragingTrainingMaster / ParallelWrapper control planes,
+- parameter **pytrees** instead of the single flattened view array
+  (reference: MultiLayerNetwork.java:349-440) — contiguity is XLA's job.
+
+Package layout:
+  ops/        tensor substrate: dtype policy, RNG policy, activation registry
+  nn/         configs (builder DSL + JSON), layers, containers
+  optimize/   updaters, LR schedules, solvers, listeners
+  datasets/   DataSetIterator protocol, fetchers, async prefetch
+  eval/       Evaluation / RegressionEvaluation / ConfusionMatrix
+  parallel/   device-mesh data parallelism, parameter-averaging mode
+  models/     LeNet-5, ResNet-50, char-RNN, word2vec, ...
+  utils/      serialization (checkpoints), gradient checking
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    DataSet,
+    DataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)

@@ -1,0 +1,211 @@
+"""Data pipeline: DataSet, DataSetIterator protocol, async prefetch.
+
+Mirrors the reference's ``datasets`` package (SURVEY.md section 2.1):
+``DataSet`` (features/labels + masks), ``DataSetIterator`` API
+(BaseDatasetIterator), ``AsyncDataSetIterator`` (background prefetch thread
+with a blocking queue — AsyncDataSetIterator.java:30; this is the device-feed
+boundary in the reference's training loop, MultiLayerNetwork.java:1020-1021),
+``MultipleEpochsIterator``, ``SamplingDataSetIterator``.
+
+TPU notes: the async iterator moves host->device transfer off the training
+thread via ``jax.device_put``; batches should be fixed-shape so the jitted
+train step compiles once.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+import jax
+import numpy as np
+
+
+@dataclass
+class DataSet:
+    """features/labels (+ optional masks) minibatch (reference org.nd4j DataSet
+    as used throughout dl4j; masks per TestVariableLengthTS semantics)."""
+
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features).shape[0])
+
+
+class DataSetIterator:
+    """Iterator protocol. Python iteration + reset(), matching the reference's
+    hasNext/next/reset surface."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over an in-memory array pair in minibatches (reference
+    ListDataSetIterator / IteratorDataSetIterator)."""
+
+    def __init__(
+        self,
+        features,
+        labels,
+        batch: int,
+        masks=None,
+        label_masks=None,
+        drop_partial: bool = False,
+    ):
+        """drop_partial=True drops a trailing short batch — useful on TPU to
+        keep shapes static (one compile); default False matches the reference
+        iterator, which returns the final partial batch."""
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.masks = None if masks is None else np.asarray(masks)
+        self.label_masks = None if label_masks is None else np.asarray(label_masks)
+        self._batch = int(batch)
+        self.drop_partial = drop_partial
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        for i in range(0, n, self._batch):
+            if self.drop_partial and i + self._batch > n:
+                break
+            sl = slice(i, min(i + self._batch, n))
+            yield DataSet(
+                self.features[sl],
+                self.labels[sl],
+                None if self.masks is None else self.masks[sl],
+                None if self.label_masks is None else self.label_masks[sl],
+            )
+
+    def batch_size(self):
+        return self._batch
+
+    def total_examples(self):
+        return int(self.features.shape[0])
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference
+    AsyncDataSetIterator.java:30). Overlaps host-side batch assembly and
+    host->device transfer with device compute."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 2, device_put: bool = True):
+        self.base = base
+        self.queue_size = max(1, int(queue_size))
+        self.device_put = device_put
+
+    def _put(self, q: "queue.Queue", stop: threading.Event, item) -> bool:
+        """Bounded put that gives up when the consumer abandoned iteration
+        (prevents the producer thread hanging in q.put forever)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self, q: "queue.Queue", stop: threading.Event):
+        try:
+            for ds in self.base:
+                if stop.is_set():
+                    return
+                if self.device_put:
+                    ds = DataSet(
+                        jax.device_put(ds.features),
+                        jax.device_put(ds.labels),
+                        None
+                        if ds.features_mask is None
+                        else jax.device_put(ds.features_mask),
+                        None
+                        if ds.labels_mask is None
+                        else jax.device_put(ds.labels_mask),
+                    )
+                if not self._put(q, stop, ds):
+                    return
+        finally:
+            self._put(q, stop, self._SENTINEL)
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        stop = threading.Event()
+        t = threading.Thread(target=self._producer, args=(q, stop), daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.base.total_examples()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat a base iterator N epochs (reference MultipleEpochsIterator)."""
+
+    def __init__(self, num_epochs: int, base: DataSetIterator):
+        self.num_epochs = int(num_epochs)
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.num_epochs):
+            yield from self.base
+            self.base.reset()
+
+    def reset(self):
+        self.base.reset()
+
+    def batch_size(self):
+        return self.base.batch_size()
+
+    def total_examples(self):
+        return self.base.total_examples() * self.num_epochs
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample minibatches with replacement (reference SamplingDataSetIterator)."""
+
+    def __init__(self, features, labels, batch: int, total_batches: int, seed: int = 0):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self._batch = int(batch)
+        self.total_batches = int(total_batches)
+        self._rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        for _ in range(self.total_batches):
+            idx = self._rng.integers(0, n, size=self._batch)
+            yield DataSet(self.features[idx], self.labels[idx])
+
+    def batch_size(self):
+        return self._batch
+
+    def total_examples(self):
+        return self._batch * self.total_batches

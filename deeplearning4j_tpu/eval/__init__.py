@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.eval.evaluation import (
+    ConfusionMatrix,
+    Evaluation,
+    RegressionEvaluation,
+)

@@ -1,0 +1,190 @@
+"""Classification / regression evaluation.
+
+Mirrors the reference's ``eval`` package (SURVEY.md section 2.1):
+``Evaluation`` (868 LoC — accuracy/precision/recall/F1 from a ConfusionMatrix,
+eval(realOutcomes, guesses) at Evaluation.java:168, time-series + masked
+variants, stats() report, merge() at :795 for distributed reduce),
+``RegressionEvaluation`` (MSE/MAE/RMSE/R2 per column), ``ConfusionMatrix``.
+
+Host-side numpy: evaluation is not in the jit hot path; outputs are devices'
+batched argmax results. `merge` supports the map-reduce distributed eval
+pattern (dl4j-spark EvaluationReduceFunction.java:18-19).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def merge(self, other: "ConfusionMatrix"):
+        self.matrix += other.matrix
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+class Evaluation:
+    """Multi-class classification metrics (reference eval/Evaluation.java)."""
+
+    def __init__(self, num_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """labels/predictions: [N, C] one-hot/probabilities, or time series
+        [N, T, C] with optional mask [N, T] (reference time-series variants)."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:
+            n, t, c = labels.shape
+            labels = labels.reshape(n * t, c)
+            predictions = predictions.reshape(n * t, c)
+            if mask is not None:
+                flat = np.asarray(mask).reshape(n * t).astype(bool)
+                labels = labels[flat]
+                predictions = predictions[flat]
+        self._ensure(labels.shape[-1])
+        actual = labels.argmax(axis=-1)
+        guess = predictions.argmax(axis=-1)
+        for a, g in zip(actual, guess):
+            self.confusion.add(int(a), int(g))
+
+    # -- metrics ------------------------------------------------------------
+    @property
+    def _m(self):
+        if self.confusion is None:
+            raise ValueError("no evaluations recorded")
+        return self.confusion.matrix
+
+    def accuracy(self) -> float:
+        m = self._m
+        total = m.sum()
+        return float(np.trace(m)) / total if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        m = self._m
+        if cls is not None:
+            denom = m[:, cls].sum()
+            return float(m[cls, cls]) / denom if denom else 0.0
+        vals = [self.precision(c) for c in range(m.shape[0]) if m[:, c].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        m = self._m
+        if cls is not None:
+            denom = m[cls, :].sum()
+            return float(m[cls, cls]) / denom if denom else 0.0
+        vals = [self.recall(c) for c in range(m.shape[0]) if m[c, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p = self.precision(cls)
+        r = self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def merge(self, other: "Evaluation"):
+        """Distributed-eval reduce (reference Evaluation.merge :795)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        self.confusion.merge(other.confusion)
+        return self
+
+    def stats(self) -> str:
+        m = self._m
+        lines = [
+            "==========================Scores========================================",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "========================================================================",
+            "Confusion matrix:",
+            str(self.confusion),
+        ]
+        return "\n".join(lines)
+
+
+class RegressionEvaluation:
+    """Per-column regression metrics (reference eval/RegressionEvaluation.java):
+    MSE, MAE, RMSE, RSE-based R^2, correlation."""
+
+    def __init__(self, num_columns: Optional[int] = None):
+        self.num_columns = num_columns
+        self._labels: List[np.ndarray] = []
+        self._preds: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, dtype=np.float64)
+        predictions = np.asarray(predictions, dtype=np.float64)
+        if labels.ndim == 3:
+            n, t, c = labels.shape
+            labels = labels.reshape(n * t, c)
+            predictions = predictions.reshape(n * t, c)
+            if mask is not None:
+                flat = np.asarray(mask).reshape(n * t).astype(bool)
+                labels = labels[flat]
+                predictions = predictions[flat]
+        self.num_columns = self.num_columns or labels.shape[-1]
+        self._labels.append(labels)
+        self._preds.append(predictions)
+
+    def _stacked(self):
+        return np.concatenate(self._labels), np.concatenate(self._preds)
+
+    def mean_squared_error(self, col: int) -> float:
+        l, p = self._stacked()
+        return float(np.mean((l[:, col] - p[:, col]) ** 2))
+
+    def mean_absolute_error(self, col: int) -> float:
+        l, p = self._stacked()
+        return float(np.mean(np.abs(l[:, col] - p[:, col])))
+
+    def root_mean_squared_error(self, col: int) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def r_squared(self, col: int) -> float:
+        l, p = self._stacked()
+        ss_res = np.sum((l[:, col] - p[:, col]) ** 2)
+        ss_tot = np.sum((l[:, col] - np.mean(l[:, col])) ** 2)
+        return float(1.0 - ss_res / ss_tot) if ss_tot else 0.0
+
+    def correlation_r2(self, col: int) -> float:
+        l, p = self._stacked()
+        if np.std(l[:, col]) == 0 or np.std(p[:, col]) == 0:
+            return 0.0
+        return float(np.corrcoef(l[:, col], p[:, col])[0, 1] ** 2)
+
+    def stats(self) -> str:
+        cols = self.num_columns or 0
+        lines = ["column  MSE        MAE        RMSE       R^2"]
+        for c in range(cols):
+            lines.append(
+                f"{c:<7d} {self.mean_squared_error(c):<10.5f} "
+                f"{self.mean_absolute_error(c):<10.5f} "
+                f"{self.root_mean_squared_error(c):<10.5f} "
+                f"{self.r_squared(c):<10.5f}"
+            )
+        return "\n".join(lines)

@@ -1,0 +1,7 @@
+"""Reference model zoo — the BASELINE.json workload configs.
+
+  lenet      — LeNet-5 MNIST (BASELINE configs[0])
+  char_rnn   — MLP + LSTM char-RNN (configs[1])
+  resnet     — ResNet-50 (configs[2], ComputationGraph-based)
+  word2vec   — skip-gram embeddings (configs[3], nlp package)
+"""

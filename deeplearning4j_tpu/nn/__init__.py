@@ -1,0 +1,8 @@
+"""Neural-network core: configs, layers, containers.
+
+Maps the reference's nn/* tree (SURVEY.md section 2.1) into a functional,
+jit-first design: layer *configs* are serializable dataclasses (the model
+identity, like the reference's Jackson configs), layer *implementations* are
+pure ``init``/``apply`` functions over param pytrees, and the containers
+(MultiLayerNetwork, ComputationGraph) assemble one jittable forward/loss.
+"""

@@ -1,0 +1,29 @@
+"""Configuration DSL.
+
+The serializable model spec — same role as the reference's
+``NeuralNetConfiguration.Builder`` -> ``MultiLayerConfiguration`` /
+``ComputationGraphConfiguration`` Jackson tree
+(deeplearning4j-core/.../nn/conf/NeuralNetConfiguration.java:285-345,377-703).
+Configs are frozen dataclasses with JSON round-trip; they are the unit that
+checkpoints, broadcast, and the CLI exchange.
+"""
+
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GRU,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LocalResponseNormalization,
+    OutputLayer,
+    RBM,
+    RnnOutputLayer,
+    SubsamplingLayer,
+    layer_from_dict,
+)
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration

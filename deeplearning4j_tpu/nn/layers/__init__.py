@@ -1,0 +1,15 @@
+"""Runtime layer implementations.
+
+Functional equivalents of the reference's ``nn/layers/**`` runtime classes
+(SURVEY.md section 2.1 "nn/layers"): each config dataclass in
+``nn/conf/layers.py`` maps (via :mod:`.factory`) to an impl exposing
+
+    initialize(key, input_shape) -> (params, state, output_shape)
+    apply(params, state, x, *, train, rng, mask) -> (y, new_state)
+
+There is no ``backpropGradient`` anywhere — jax autodiff differentiates the
+whole network; the reference's hand-written backward passes survive only as
+the gradient-check oracle in utils/gradient_check.py.
+"""
+
+from deeplearning4j_tpu.nn.layers.factory import create_layer

@@ -1,0 +1,79 @@
+"""Layer protocol + shared helpers (dropout, dense affine).
+
+Role of the reference's ``BaseLayer``
+(deeplearning4j-core/.../nn/layers/BaseLayer.java): activation application
+(:369-372, by name through the op registry) and inverted-dropout on layer
+input (:455 applyDropOutIfNecessary; util/Dropout.java).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import activation
+
+Array = jax.Array
+Params = Dict[str, Array]
+State = Dict[str, Array]
+
+
+def inverted_dropout(x: Array, rate: float, train: bool, rng: Optional[Array]) -> Array:
+    """Inverted dropout, applied to layer *input* (reference util/Dropout.java:
+    retain with prob (1-rate), scale by 1/(1-rate) at train time)."""
+    if not train or rate <= 0.0:
+        return x
+    if rng is None:
+        raise ValueError("dropout requires an rng key at train time")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class BaseLayerImpl:
+    """Base for all runtime layers. Subclasses set params in `initialize` and
+    define `apply`. Stateless layers return `state={}` unchanged."""
+
+    def __init__(self, conf):
+        self.conf = conf
+        self.act = activation(conf.activation) if conf.activation else None
+
+    # -- override points ----------------------------------------------------
+    def initialize(self, key, input_shape) -> Tuple[Params, State, Tuple[int, ...]]:
+        raise NotImplementedError
+
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x: Array,
+        *,
+        train: bool = False,
+        rng: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Tuple[Array, State]:
+        raise NotImplementedError
+
+    # -- helpers ------------------------------------------------------------
+    def _dropout_in(self, x, train, rng):
+        return inverted_dropout(x, self.conf.dropout or 0.0, train, rng)
+
+    def _init_dense_params(self, key, n_in, n_out) -> Params:
+        wkey, _ = jax.random.split(key)
+        W = init_weights(
+            wkey,
+            (n_in, n_out),
+            self.conf.weight_init,
+            fan_in=n_in,
+            fan_out=n_out,
+            dist=self.conf.dist,
+        )
+        b = jnp.full((n_out,), self.conf.bias_init or 0.0, jnp.float32)
+        return {"W": W, "b": b}
+
+    # Regularizable param names: l1/l2 apply to weights, not biases
+    # (reference BaseLayer.calcL2/calcL1 use only W).
+    WEIGHT_PARAMS = ("W",)

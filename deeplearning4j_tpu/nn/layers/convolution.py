@@ -1,0 +1,92 @@
+"""Convolution + pooling layers.
+
+Reference runtime: nn/layers/convolution/ConvolutionLayer.java (im2col+gemm,
+:146-166) and SubsamplingLayer.java (326 LoC), accelerated by the cuDNN
+helpers in deeplearning4j-cuda-7.5. On TPU both lower to native XLA HLOs —
+``lax.conv_general_dilated`` hits the MXU directly; pooling is
+``lax.reduce_window`` — so the whole Java+cuDNN helper stack collapses into
+this file (SURVEY.md section 2.2 closing note).
+
+Layout: NHWC activations, HWIO weights (TPU-friendly; reference is NCHW).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import BaseLayerImpl
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+class ConvolutionLayerImpl(BaseLayerImpl):
+    def initialize(self, key, input_shape):
+        h, w, c_in = input_shape
+        conf = self.conf
+        if conf.n_in and conf.n_in != c_in:
+            raise ValueError(f"conv n_in={conf.n_in} != input channels {c_in}")
+        kh, kw = conf.kernel_size
+        fan_in = c_in * kh * kw
+        fan_out = conf.n_out * kh * kw
+        W = init_weights(
+            key,
+            (kh, kw, c_in, conf.n_out),
+            conf.weight_init,
+            fan_in=fan_in,
+            fan_out=fan_out,
+            dist=conf.dist,
+        )
+        b = jnp.full((conf.n_out,), conf.bias_init or 0.0, jnp.float32)
+        oh = (h + 2 * conf.padding[0] - kh) // conf.stride[0] + 1
+        ow = (w + 2 * conf.padding[1] - kw) // conf.stride[1] + 1
+        return {"W": W, "b": b}, {}, (oh, ow, conf.n_out)
+
+    def preout(self, params, x):
+        conf = self.conf
+        pad = [(conf.padding[0],) * 2, (conf.padding[1],) * 2]
+        y = lax.conv_general_dilated(
+            x,
+            params["W"],
+            window_strides=conf.stride,
+            padding=pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return y + params["b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self.act(self.preout(params, x)), state
+
+
+class SubsamplingLayerImpl(BaseLayerImpl):
+    """MAX / AVG / SUM pooling (reference SubsamplingLayer PoolingType)."""
+
+    def initialize(self, key, input_shape):
+        h, w, c = input_shape
+        kh, kw = self.conf.kernel_size
+        sh, sw = self.conf.stride
+        ph, pw = self.conf.padding
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        return {}, {}, (oh, ow, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        conf = self.conf
+        kh, kw = conf.kernel_size
+        sh, sw = conf.stride
+        ph, pw = conf.padding
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        padding = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+        pt = conf.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, padding)
+        elif pt in ("avg", "average"):
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+            y = s / float(kh * kw)
+        elif pt == "sum":
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+        else:
+            raise ValueError(f"unknown pooling type {pt}")
+        return y, state

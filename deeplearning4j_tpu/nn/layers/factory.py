@@ -1,0 +1,82 @@
+"""Config -> runtime layer mapping.
+
+Role of the reference's ``nn/layers/factory/LayerFactories``
+(deeplearning4j-core/.../nn/layers/factory/) which maps conf classes to
+runtime impls. Kept as an explicit registry so alternative backends
+(e.g. pallas-kernel variants) can be swapped in per layer type — the
+TPU equivalent of the reference's reflective cuDNN-helper loading
+(ConvolutionLayer.java:64-70).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import layers as conf_layers
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayerImpl,
+    SubsamplingLayerImpl,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    ActivationLayerImpl,
+    AutoEncoderImpl,
+    DenseLayerImpl,
+    EmbeddingLayerImpl,
+    OutputLayerImpl,
+    RBMImpl,
+    RnnOutputLayerImpl,
+)
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalizationImpl,
+    LocalResponseNormalizationImpl,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    GRUImpl,
+    GravesBidirectionalLSTMImpl,
+    GravesLSTMImpl,
+)
+
+FACTORY = {
+    conf_layers.DenseLayer: DenseLayerImpl,
+    conf_layers.OutputLayer: OutputLayerImpl,
+    conf_layers.RnnOutputLayer: RnnOutputLayerImpl,
+    conf_layers.EmbeddingLayer: EmbeddingLayerImpl,
+    conf_layers.ActivationLayer: ActivationLayerImpl,
+    conf_layers.AutoEncoder: AutoEncoderImpl,
+    conf_layers.RBM: RBMImpl,
+    conf_layers.ConvolutionLayer: ConvolutionLayerImpl,
+    conf_layers.SubsamplingLayer: SubsamplingLayerImpl,
+    conf_layers.BatchNormalization: BatchNormalizationImpl,
+    conf_layers.LocalResponseNormalization: LocalResponseNormalizationImpl,
+    conf_layers.GravesLSTM: GravesLSTMImpl,
+    conf_layers.GravesBidirectionalLSTM: GravesBidirectionalLSTMImpl,
+    conf_layers.GRU: GRUImpl,
+}
+
+# recurrent layers with carryable state (TBPTT chaining / rnnTimeStep)
+STATEFUL_RNN_CONFS = (
+    conf_layers.GravesLSTM,
+    conf_layers.GravesBidirectionalLSTM,
+    conf_layers.GRU,
+)
+
+# layer families for preprocessor auto-insertion / input-type checking
+RNN_CONFS = (
+    conf_layers.GravesLSTM,
+    conf_layers.GravesBidirectionalLSTM,
+    conf_layers.GRU,
+    conf_layers.RnnOutputLayer,
+)
+CNN_CONFS = (
+    conf_layers.ConvolutionLayer,
+    conf_layers.SubsamplingLayer,
+    conf_layers.LocalResponseNormalization,
+)
+
+
+def create_layer(conf):
+    try:
+        impl_cls = FACTORY[type(conf)]
+    except KeyError:
+        raise ValueError(
+            f"No runtime implementation for layer conf {type(conf).__name__}"
+        ) from None
+    return impl_cls(conf)

@@ -1,0 +1,205 @@
+"""Feedforward layers: Dense, Output, Embedding, Activation, AutoEncoder, RBM.
+
+Reference runtime classes (SURVEY.md section 2.1 "nn/layers"):
+  - feedforward/dense/DenseLayer.java (via BaseLayer.preOutput/activate)
+  - BaseOutputLayer.java / OutputLayer.java (loss handled by the container)
+  - feedforward/embedding/EmbeddingLayer.java (gather fwd, scatter-add bwd —
+    here XLA's take/segment-sum)
+  - feedforward/autoencoder/AutoEncoder.java (denoising AE; corruption +
+    reconstruct with tied-ish decoder W^T + visible bias)
+  - feedforward/rbm/RBM.java:101-137 (CD-k contrastiveDivergence) — Gibbs
+    sampling expressed with explicit jax.random keys; the CD parameter update
+    is computed in closed form (it is not a loss gradient).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import BaseLayerImpl
+from deeplearning4j_tpu.nn.losses import loss_fn
+from deeplearning4j_tpu.ops.activations import activation
+
+
+class DenseLayerImpl(BaseLayerImpl):
+    def initialize(self, key, input_shape):
+        n_in = self.conf.n_in or input_shape[-1]
+        params = self._init_dense_params(key, n_in, self.conf.n_out)
+        return params, {}, (self.conf.n_out,)
+
+    def preout(self, params, x):
+        return x @ params["W"] + params["b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self.act(self.preout(params, x)), state
+
+
+class OutputLayerImpl(DenseLayerImpl):
+    """Dense + loss function. The container computes the loss from `preout`
+    (fusing softmax+MCXENT via log-softmax, BaseOutputLayer.java:90-91);
+    `apply` yields the activated output for inference."""
+
+    def loss(self, params, x, labels, mask=None):
+        from deeplearning4j_tpu.nn import losses
+
+        z = self.preout(params, x)
+        name = self.conf.loss_function
+        if losses.fused_with_softmax(name) and self.conf.activation == "softmax":
+            return losses.mcxent_from_logits(labels, z, mask)
+        return loss_fn(name)(labels, self.act(z), mask)
+
+
+class RnnOutputLayerImpl(OutputLayerImpl):
+    """Applies the dense output per timestep on [N,T,F] input
+    (reference: recurrent/RnnOutputLayer.java — 2d reshape + super)."""
+
+    def initialize(self, key, input_shape):
+        t, f = input_shape
+        n_in = self.conf.n_in or f
+        params = self._init_dense_params(key, n_in, self.conf.n_out)
+        return params, {}, (t, self.conf.n_out)
+
+    def preout(self, params, x):
+        return x @ params["W"] + params["b"]  # broadcasting handles [N,T,F]
+
+
+class EmbeddingLayerImpl(BaseLayerImpl):
+    def initialize(self, key, input_shape):
+        n_in = self.conf.n_in  # vocab size; cannot be inferred from data shape
+        params = self._init_dense_params(key, n_in, self.conf.n_out)
+        return params, {}, (self.conf.n_out,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim >= 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]  # reference passes [N,1] index column
+        y = jnp.take(params["W"], idx, axis=0) + params["b"]
+        return self.act(y), state
+
+
+class ActivationLayerImpl(BaseLayerImpl):
+    def initialize(self, key, input_shape):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self.act(x), state
+
+
+class AutoEncoderImpl(BaseLayerImpl):
+    """Denoising autoencoder. Forward = encoder; pretraining objective =
+    reconstruction loss after input corruption
+    (reference feedforward/autoencoder/AutoEncoder.java)."""
+
+    def initialize(self, key, input_shape):
+        n_in = self.conf.n_in or input_shape[-1]
+        params = self._init_dense_params(key, n_in, self.conf.n_out)
+        params["vb"] = jnp.zeros((n_in,), jnp.float32)  # visible bias
+        return params, {}, (self.conf.n_out,)
+
+    def encode(self, params, x):
+        return self.act(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self.act(h @ params["W"].T + params["vb"])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self.encode(params, x), state
+
+    def pretrain_loss(self, params, x, rng):
+        corrupted = x
+        if self.conf.corruption_level and self.conf.corruption_level > 0:
+            keep = jax.random.bernoulli(
+                rng, 1.0 - self.conf.corruption_level, x.shape
+            )
+            corrupted = jnp.where(keep, x, 0.0)
+        recon = self.decode(params, self.encode(params, corrupted))
+        return loss_fn(self.conf.loss_function)(x, recon, None)
+
+
+class RBMImpl(BaseLayerImpl):
+    """RBM with CD-k pretraining (reference feedforward/rbm/RBM.java; CD loop
+    :101-137). Unit types: binary | gaussian | rectified (visible/hidden)."""
+
+    def initialize(self, key, input_shape):
+        n_in = self.conf.n_in or input_shape[-1]
+        params = self._init_dense_params(key, n_in, self.conf.n_out)
+        params["vb"] = jnp.zeros((n_in,), jnp.float32)
+        return params, {}, (self.conf.n_out,)
+
+    # -- unit activations ----------------------------------------------------
+    def _hidden_mean(self, params, v):
+        z = v @ params["W"] + params["b"]
+        h = self.conf.hidden_unit
+        if h == "binary":
+            return jax.nn.sigmoid(z)
+        if h == "rectified":
+            return jax.nn.relu(z)
+        if h == "gaussian":
+            return z
+        if h == "softmax":
+            return jax.nn.softmax(z, axis=-1)
+        raise ValueError(f"unknown hidden unit {h}")
+
+    def _visible_mean(self, params, h):
+        z = h @ params["W"].T + params["vb"]
+        v = self.conf.visible_unit
+        if v == "binary":
+            return jax.nn.sigmoid(z)
+        if v == "gaussian":
+            return z
+        if v == "linear":
+            return z
+        if v == "softmax":
+            return jax.nn.softmax(z, axis=-1)
+        raise ValueError(f"unknown visible unit {v}")
+
+    def _sample_hidden(self, params, v, key):
+        mean = self._hidden_mean(params, v)
+        if self.conf.hidden_unit == "binary":
+            return jax.random.bernoulli(key, mean).astype(v.dtype), mean
+        if self.conf.hidden_unit == "gaussian":
+            return mean + jax.random.normal(key, mean.shape, mean.dtype), mean
+        return mean, mean
+
+    def _sample_visible(self, params, h, key):
+        mean = self._visible_mean(params, h)
+        if self.conf.visible_unit == "binary":
+            return jax.random.bernoulli(key, mean).astype(h.dtype), mean
+        if self.conf.visible_unit == "gaussian":
+            return mean + jax.random.normal(key, mean.shape, mean.dtype), mean
+        return mean, mean
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self._dropout_in(x, train, rng)
+        return self._hidden_mean(params, x), state
+
+    def cd_grads(self, params, v0, rng):
+        """CD-k gradient estimate: positive phase <v0 h0> minus negative phase
+        <vk hk>, normalized per example. Returns a grads dict with the SAME
+        keys as params (sign: gradient-to-*subtract*, matching our updaters).
+        Reference math: RBM.java contrastiveDivergence :101-137."""
+        k = max(1, int(self.conf.k))
+        h0_mean = self._hidden_mean(params, v0)
+        keys = jax.random.split(rng, 2 * k + 1)
+        h_sample, _ = self._sample_hidden(params, v0, keys[0])
+        vk, hk_mean = v0, h0_mean
+        for i in range(k):
+            vk, _ = self._sample_visible(params, h_sample, keys[2 * i + 1])
+            h_sample, hk_mean = self._sample_hidden(params, vk, keys[2 * i + 2])
+        n = v0.shape[0]
+        gW = -(v0.T @ h0_mean - vk.T @ hk_mean) / n
+        gb = -jnp.mean(h0_mean - hk_mean, axis=0)
+        gvb = -jnp.mean(v0 - vk, axis=0)
+        return {"W": gW, "b": gb, "vb": gvb}
+
+    def pretrain_loss(self, params, x, rng):
+        """Monitoring proxy: reconstruction cross-entropy after one Gibbs step."""
+        h = self._hidden_mean(params, x)
+        recon = self._visible_mean(params, h)
+        return loss_fn("reconstruction_crossentropy")(x, recon, None)

@@ -1,0 +1,79 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference runtime: nn/layers/normalization/BatchNormalization.java (348 LoC)
+and LocalResponseNormalization.java, with cuDNN helpers in the cuda module
+(CudnnBatchNormalizationHelper.java, CudnnLocalResponseNormalizationHelper.java).
+Both are plain fused XLA element-wise/reduction code here.
+
+BatchNorm state: running mean/var live in the layer *state* pytree (the
+reference stores them in the flat param vector via
+BatchNormalizationParamInitializer — gamma/beta/mean/var); only gamma/beta are
+trainable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import BaseLayerImpl
+
+
+class BatchNormalizationImpl(BaseLayerImpl):
+    def initialize(self, key, input_shape):
+        n = input_shape[-1]  # features (FF) or channels (NHWC CNN)
+        conf = self.conf
+        params = {}
+        if not conf.lock_gamma_beta:
+            params["gamma"] = jnp.full((n,), conf.gamma, jnp.float32)
+            params["beta"] = jnp.full((n,), conf.beta, jnp.float32)
+        state = {
+            "mean": jnp.zeros((n,), jnp.float32),
+            "var": jnp.ones((n,), jnp.float32),
+        }
+        return params, state, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        conf = self.conf
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            # running estimates: new = decay*old + (1-decay)*batch
+            new_state = {
+                "mean": conf.decay * state["mean"] + (1 - conf.decay) * mean,
+                "var": conf.decay * state["var"] + (1 - conf.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + conf.eps)
+        if conf.lock_gamma_beta:
+            y = conf.gamma * xhat + conf.beta
+        else:
+            y = params["gamma"] * xhat + params["beta"]
+        return y, new_state
+
+
+class LocalResponseNormalizationImpl(BaseLayerImpl):
+    """Cross-channel LRN on NHWC: y = x / (k + alpha*sum_window(x^2))^beta
+    (reference LocalResponseNormalization.java; AlexNet-style)."""
+
+    def initialize(self, key, input_shape):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        conf = self.conf
+        half = int(conf.n) // 2
+        sq = x * x
+        # sum over a window of `n` adjacent channels (last axis)
+        window = lax.reduce_window(
+            sq,
+            0.0,
+            lax.add,
+            (1,) * (x.ndim - 1) + (int(conf.n),),
+            (1,) * x.ndim,
+            ((0, 0),) * (x.ndim - 1) + ((half, int(conf.n) - 1 - half),),
+        )
+        return x / (conf.k + conf.alpha * window) ** conf.beta, state

@@ -1,0 +1,118 @@
+"""Loss functions for output layers.
+
+Mirrors the reference's ``LossFunctions.LossFunction`` enum used by
+``BaseOutputLayer`` (deeplearning4j-core/.../nn/layers/BaseOutputLayer.java:89-116,198):
+MSE, EXPLL, XENT, MCXENT, RMSE_XENT, SQUARED_LOSS, RECONSTRUCTION_CROSSENTROPY,
+NEGATIVELOGLIKELIHOOD. The special case at BaseOutputLayer.java:90-91 —
+softmax + (NLL|MCXENT) computed via log-softmax for stability — is reproduced
+here by fusing the output activation into the loss when applicable.
+
+All losses:
+  - take ``(labels, preactivation_or_activation, mask)``,
+  - reduce to *mean per example* (reference score = total loss / minibatch,
+    BaseOutputLayer.computeScore),
+  - support per-timestep masks for RNN outputs (mask shape broadcastable to
+    the leading axes of labels).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-10
+
+
+def _masked_mean_per_example(per_elem: Array, mask: Optional[Array]) -> Array:
+    """Sum loss over feature axis, average over examples (and masked steps).
+
+    per_elem: [..., features] per-element loss.
+    mask: broadcastable to per_elem.shape[:-1]; 1 = keep.
+    """
+    per_row = jnp.sum(per_elem, axis=-1)  # [...]
+    if mask is not None:
+        mask = jnp.asarray(mask, per_row.dtype)
+        mask = jnp.broadcast_to(mask, per_row.shape)
+        total = jnp.sum(per_row * mask)
+        count = jnp.maximum(jnp.sum(mask), 1.0)
+        return total / count
+    return jnp.mean(per_row)
+
+
+def mse(labels, output, mask=None):
+    return _masked_mean_per_example(0.5 * (output - labels) ** 2, mask)
+
+
+def squared_loss(labels, output, mask=None):
+    return _masked_mean_per_example((output - labels) ** 2, mask)
+
+
+def rmse_xent(labels, output, mask=None):
+    # reference: sqrt of per-element squared error (legacy, rarely used)
+    return _masked_mean_per_example(jnp.sqrt((output - labels) ** 2 + _EPS), mask)
+
+
+def xent(labels, output, mask=None):
+    """Binary cross entropy; `output` is post-sigmoid activation."""
+    p = jnp.clip(output, _EPS, 1.0 - _EPS)
+    per = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    return _masked_mean_per_example(per, mask)
+
+
+def mcxent_from_logits(labels, logits, mask=None):
+    """Softmax + multi-class cross entropy fused via log-softmax.
+
+    The numerically-stable path of BaseOutputLayer.java:90-91.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return _masked_mean_per_example(-labels * logp, mask)
+
+
+def mcxent(labels, output, mask=None):
+    """Multi-class cross entropy on an already-activated output."""
+    return _masked_mean_per_example(-labels * jnp.log(jnp.clip(output, _EPS, 1.0)), mask)
+
+
+def negativeloglikelihood(labels, output, mask=None):
+    return mcxent(labels, output, mask)
+
+
+def expll(labels, output, mask=None):
+    """Exponential log likelihood (Poisson-style): mean(output - labels*log(output))."""
+    return _masked_mean_per_example(
+        output - labels * jnp.log(jnp.clip(output, _EPS, None)), mask
+    )
+
+
+def reconstruction_crossentropy(labels, output, mask=None):
+    return xent(labels, output, mask)
+
+
+LOSSES: Dict[str, Callable] = {
+    "mse": mse,
+    "squared_loss": squared_loss,
+    "rmse_xent": rmse_xent,
+    "xent": xent,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "expll": expll,
+    "reconstruction_crossentropy": reconstruction_crossentropy,
+}
+
+# Losses where the stable fused-from-logits path exists when paired with softmax.
+_FUSED_SOFTMAX = {"mcxent", "negativeloglikelihood"}
+
+
+def loss_fn(name: str) -> Callable:
+    try:
+        return LOSSES[name.lower()]
+    except KeyError:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}") from None
+
+
+def fused_with_softmax(name: str) -> bool:
+    return name.lower() in _FUSED_SOFTMAX

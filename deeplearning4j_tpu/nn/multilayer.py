@@ -1,0 +1,555 @@
+"""MultiLayerNetwork — the sequential network container.
+
+Functional re-design of the reference's ``MultiLayerNetwork`` (2,372 LoC,
+deeplearning4j-core/.../nn/multilayer/MultiLayerNetwork.java):
+
+  reference mechanism                        -> here
+  -----------------------------------------------------------------------
+  init() flat param view array (:349-440)    -> list-of-dicts param pytree
+  computeGradientAndScore (:1786)            -> jax.value_and_grad of _loss
+  backprop()/calcBackpropGradients (:1071)   -> autodiff (no hand backward)
+  Solver/StochasticGradientDescent iteration -> ONE jitted train_step:
+                                                forward+backward+updater+step
+                                                compiled to a single XLA program
+  fit(DataSetIterator) (:1017)               -> fit / fit_iterator
+  pretrain() layerwise RBM/AE (:165-213)     -> pretrain()
+  output()/feedForward (:619-704)            -> output()
+  evaluate (:2316)                           -> evaluate()
+  rnnTimeStep (:2152)                        -> rnn_time_step()  [stateful]
+  setLayerMaskArrays (:1053)                 -> mask/label_mask arguments
+  doTruncatedBPTT (:1162)                    -> fit with tbptt window slicing
+
+The whole-step jit is the single biggest architectural win over the
+reference's op-by-op dispatch (SURVEY.md section 7 "Architectural
+translations").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import layers as conf_layers
+from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.factory import (
+    CNN_CONFS,
+    RNN_CONFS,
+    STATEFUL_RNN_CONFS,
+    create_layer,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import (
+    AutoEncoderImpl,
+    OutputLayerImpl,
+    RBMImpl,
+)
+from deeplearning4j_tpu.ops import rng as rng_mod
+from deeplearning4j_tpu.optimize.updaters import MultiLayerUpdater, apply_updates
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+# param leaf names regularized by l1/l2 (weights + recurrent weights, never
+# biases — reference BaseLayer.calcL1/calcL2)
+_REG_PARAM_NAMES = ("W", "U")
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers = [create_layer(lc) for lc in conf.layers]
+        self.updater = MultiLayerUpdater(conf.layers, conf)
+        self.params: Optional[List[Dict[str, Any]]] = None
+        self.states: Optional[List[Dict[str, Any]]] = None
+        self.updater_state = None
+        self.iteration = 0
+        self.listeners = []
+        self._score_dev = None  # device array; fetched lazily via score_value
+        self._rng = rng_mod.key(conf.seed)
+        self._jit_cache: Dict[Any, Any] = {}
+        self._input_shape: Optional[Tuple[int, ...]] = None
+
+    # ------------------------------------------------------------------ init
+    def _infer_input_shape(self) -> Tuple[int, ...]:
+        l0 = self.conf.layers[0]
+        if isinstance(l0, RNN_CONFS):
+            return (-1, l0.n_in)
+        if isinstance(l0, conf_layers.ConvolutionLayer):
+            raise ValueError(
+                "CNN-first networks need an explicit input_shape=(h, w, c) "
+                "(reference requires the same via ConvolutionLayerSetup)"
+            )
+        if isinstance(l0, conf_layers.FeedForwardLayer):
+            return (l0.n_in,)
+        raise ValueError(
+            f"cannot infer input shape from first layer {type(l0).__name__}; "
+            "pass input_shape to init()"
+        )
+
+    def init(self, input_shape: Optional[Sequence[int]] = None) -> "MultiLayerNetwork":
+        """Initialize params/state, inferring per-layer shapes through the
+        stack (role of reference init() :349-440 + ConvolutionLayerSetup)."""
+        shape = tuple(input_shape) if input_shape else self._infer_input_shape()
+        self._input_shape = shape
+        params, states = [], []
+        for i, layer in enumerate(self.layers):
+            pp = self.conf.input_preprocessors.get(i)
+            if pp is not None:
+                shape = pp.out_shape(shape)
+            k = rng_mod.layer_key(self._rng, i, "init")
+            p, s, shape = layer.initialize(k, shape)
+            params.append(p)
+            states.append(s)
+        self.params = params
+        self.states = states
+        self.updater_state = self.updater.init(params)
+        return self
+
+    def num_params(self) -> int:
+        return sum(
+            int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(self.params)
+        )
+
+    # --------------------------------------------------------------- forward
+    def _apply_preprocessor(self, i, x, batch_n):
+        pp = self.conf.input_preprocessors.get(i)
+        if pp is None:
+            return x
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            CnnToRnnPreProcessor,
+            FeedForwardToRnnPreProcessor,
+        )
+
+        if isinstance(pp, (FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor)):
+            return pp(x, time_steps=x.shape[0] // batch_n)
+        return pp(x)
+
+    def _forward(
+        self,
+        params,
+        states,
+        x,
+        *,
+        train: bool,
+        rng=None,
+        mask=None,
+        upto: Optional[int] = None,
+        carry_state: bool = False,
+    ):
+        """Forward through layers [0, upto). Returns (activations list incl.
+        input, new_states). Mask is passed to recurrent-family layers only.
+        carry_state=True resumes recurrent layers from their stored state
+        (TBPTT window chaining)."""
+        n_layers = len(self.layers) if upto is None else upto
+        batch_n = x.shape[0]
+        acts = [x]
+        new_states = list(states)
+        for i in range(n_layers):
+            layer = self.layers[i]
+            x = self._apply_preprocessor(i, x, batch_n)
+            lrng = (
+                rng_mod.layer_key(rng, i, "dropout") if rng is not None else None
+            )
+            lmask = mask if isinstance(self.conf.layers[i], RNN_CONFS) else None
+            kwargs = {}
+            if carry_state and isinstance(self.conf.layers[i], STATEFUL_RNN_CONFS):
+                kwargs["carry_state"] = True
+            y, ns = layer.apply(
+                params[i], states[i], x, train=train, rng=lrng, mask=lmask, **kwargs
+            )
+            new_states[i] = ns
+            acts.append(y)
+            x = y
+        return acts, new_states
+
+    def _regularization_penalty(self, params):
+        """0.5*l2*|W|^2 + l1*|W|_1 summed over layers (weights only)."""
+        total = jnp.asarray(0.0, jnp.float32)
+        for lc, p in zip(self.conf.layers, params):
+            l1 = lc.l1 or 0.0
+            l2 = lc.l2 or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+
+            def visit(path, leaf, acc):
+                name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+                if name in _REG_PARAM_NAMES:
+                    if l2:
+                        acc = acc + 0.5 * l2 * jnp.sum(jnp.square(leaf))
+                    if l1:
+                        acc = acc + l1 * jnp.sum(jnp.abs(leaf))
+                return acc
+
+            leaves = jax.tree_util.tree_leaves_with_path(p)
+            for path, leaf in leaves:
+                total = visit(path, leaf, total)
+        return total
+
+    def _loss(
+        self,
+        params,
+        states,
+        x,
+        labels,
+        *,
+        train,
+        rng,
+        mask=None,
+        label_mask=None,
+        carry_state: bool = False,
+    ):
+        out_impl = self.layers[-1]
+        if not isinstance(out_impl, OutputLayerImpl):
+            raise ValueError("last layer must be an OutputLayer/RnnOutputLayer")
+        acts, new_states = self._forward(
+            params,
+            states,
+            x,
+            train=train,
+            rng=rng,
+            mask=mask,
+            upto=len(self.layers) - 1,
+            carry_state=carry_state,
+        )
+        last_in = self._apply_preprocessor(
+            len(self.layers) - 1, acts[-1], x.shape[0]
+        )
+        if train and (self.conf.layers[-1].dropout or 0.0) > 0 and rng is not None:
+            last_in = out_impl._dropout_in(
+                last_in, train, rng_mod.layer_key(rng, len(self.layers) - 1, "dropout")
+            )
+        lmask = label_mask if label_mask is not None else mask
+        loss = out_impl.loss(params[-1], last_in, labels, lmask)
+        return loss + self._regularization_penalty(params), new_states
+
+    # ------------------------------------------------------------- jit cache
+    def _get_train_step(
+        self, has_mask: bool, has_label_mask: bool, carry_state: bool = False
+    ):
+        key = ("train_step", has_mask, has_label_mask, carry_state)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+
+        def train_step(params, states, upd_state, x, labels, iteration, rng, mask, label_mask):
+            def loss_fn(p):
+                return self._loss(
+                    p,
+                    states,
+                    x,
+                    labels,
+                    train=True,
+                    rng=rng,
+                    mask=mask,
+                    label_mask=label_mask,
+                    carry_state=carry_state,
+                )
+
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params
+            )
+            updates, upd_state = self.updater.update(
+                grads, upd_state, params, iteration
+            )
+            params = apply_updates(params, updates, self.conf.minimize)
+            return params, new_states, upd_state, loss
+
+        fn = jax.jit(train_step)
+        self._jit_cache[key] = fn
+        return fn
+
+    def _get_output_fn(self, train: bool = False):
+        key = ("output", train)
+        if key not in self._jit_cache:
+
+            def out_fn(params, states, x):
+                acts, _ = self._forward(params, states, x, train=False)
+                return acts[-1]
+
+            self._jit_cache[key] = jax.jit(out_fn)
+        return self._jit_cache[key]
+
+    def _get_score_fn(self, has_mask: bool, has_label_mask: bool):
+        key = ("score", has_mask, has_label_mask)
+        if key not in self._jit_cache:
+
+            def score_fn(params, states, x, labels, mask, label_mask):
+                loss, _ = self._loss(
+                    params,
+                    states,
+                    x,
+                    labels,
+                    train=False,
+                    rng=None,
+                    mask=mask,
+                    label_mask=label_mask,
+                )
+                return loss
+
+            self._jit_cache[key] = jax.jit(score_fn)
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------- fit
+    @property
+    def score_value(self) -> float:
+        """Last training loss. Syncing with the device happens HERE, not in
+        the step loop — fit() stays async so steps pipeline on TPU (the
+        reference's per-iteration score readback is a hidden sync point)."""
+        return float("nan") if self._score_dev is None else float(self._score_dev)
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score_dev = v
+
+    def _record_iteration(self, loss):
+        self._score_dev = loss
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, float(loss))
+        self.iteration += 1
+
+    def fit(self, features, labels, mask=None, label_mask=None) -> float:
+        """One DataSet fit: `conf.iterations` optimizer iterations on this
+        batch (reference fit(DataSet) semantics with the Solver loop)."""
+        if self.params is None:
+            self.init()
+        features = jnp.asarray(features)
+        labels = jnp.asarray(labels)
+        if self.conf.backprop_type == "truncated_bptt" and features.ndim == 3:
+            return self._fit_tbptt(features, labels, mask, label_mask)
+        step = self._get_train_step(mask is not None, label_mask is not None)
+        loss = None
+        for _ in range(max(1, self.conf.iterations)):
+            srng = rng_mod.step_key(self._rng, self.iteration)
+            self.params, self.states, self.updater_state, loss = step(
+                self.params,
+                self.states,
+                self.updater_state,
+                features,
+                labels,
+                jnp.asarray(self.iteration, jnp.int32),
+                srng,
+                mask,
+                label_mask,
+            )
+            self._record_iteration(loss)
+        return loss
+
+    def _reset_rnn_states(self, batch_n: int) -> None:
+        """Zero recurrent state sized for this batch (sequence start —
+        reference rnnClearPreviousState before doTruncatedBPTT)."""
+        for i, lc in enumerate(self.conf.layers):
+            if isinstance(lc, STATEFUL_RNN_CONFS):
+                self.states[i] = {
+                    k: jnp.zeros((batch_n, lc.n_out), jnp.float32)
+                    for k in self.states[i]
+                }
+
+    def _fit_tbptt(self, features, labels, mask=None, label_mask=None) -> float:
+        """Truncated BPTT: slice the time axis into fwd-length windows;
+        recurrent state carries forward across windows (stop-gradient at the
+        window boundary — state enters the next jitted step as data), matching
+        reference doTruncatedBPTT :1162-1233."""
+        t_total = features.shape[1]
+        w = self.conf.tbptt_fwd_length
+        loss = float("nan")
+        self._reset_rnn_states(features.shape[0])
+        for window_start in range(0, t_total, w):
+            sl = slice(window_start, min(window_start + w, t_total))
+            f_w = features[:, sl]
+            l_w = labels[:, sl] if labels.ndim == 3 else labels
+            m_w = mask[:, sl] if mask is not None else None
+            lm_w = label_mask[:, sl] if label_mask is not None else None
+            step = self._get_train_step(
+                m_w is not None, lm_w is not None, carry_state=True
+            )
+            srng = rng_mod.step_key(self._rng, self.iteration)
+            self.params, self.states, self.updater_state, loss = step(
+                self.params,
+                self.states,
+                self.updater_state,
+                f_w,
+                l_w,
+                jnp.asarray(self.iteration, jnp.int32),
+                srng,
+                m_w,
+                lm_w,
+            )
+            self._record_iteration(loss)
+        return loss
+
+    def fit_iterator(self, iterator, num_epochs: int = 1) -> "MultiLayerNetwork":
+        """fit(DataSetIterator) equivalent (reference :1017). Async prefetch
+        is provided by wrapping with datasets.AsyncDataSetIterator."""
+        if self.params is None:
+            self.init()
+        if self.conf.pretrain:
+            self.pretrain(iterator)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        for _ in range(num_epochs):
+            for ds in iterator:
+                self.fit(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        return self
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, data, num_epochs: int = 1) -> None:
+        """Greedy layerwise pretraining for AutoEncoder/RBM layers
+        (reference pretrain(DataSetIterator) :165-213)."""
+        if self.params is None:
+            self.init()
+
+        def batches():
+            if hasattr(data, "__iter__") and not hasattr(data, "shape"):
+                for ds in data:
+                    yield jnp.asarray(ds.features)
+                if hasattr(data, "reset"):
+                    data.reset()
+            else:
+                yield jnp.asarray(data)
+
+        for i, layer in enumerate(self.layers):
+            if not isinstance(layer, (AutoEncoderImpl, RBMImpl)):
+                continue
+            lc = self.conf.layers[i]
+            from deeplearning4j_tpu.optimize.updaters import LayerUpdater
+
+            lu = LayerUpdater(lc, self.conf)
+            lu_state = lu.init(self.params[i])
+
+            if isinstance(layer, RBMImpl):
+
+                def grads_fn(p, x, k):
+                    return layer.cd_grads(p, x, k), None
+
+            else:
+
+                def grads_fn(p, x, k):
+                    g = jax.grad(lambda pp: layer.pretrain_loss(pp, x, k))(p)
+                    return g, None
+
+            @jax.jit
+            def pretrain_step(p, s, x, it, k):
+                g, _ = grads_fn(p, x, k)
+                upd, s = lu.update(g, s, p, it)
+                p = apply_updates(p, upd, True)
+                return p, s
+
+            it_count = 0
+            for _ in range(num_epochs):
+                for xb in batches():
+                    batch_n = xb.shape[0]
+                    # forward through earlier layers in inference mode
+                    if i > 0:
+                        acts, _ = self._forward(
+                            self.params, self.states, xb, train=False, upto=i
+                        )
+                        xb = acts[-1]
+                    # apply this layer's input preprocessor (forward applies
+                    # preprocessor i only when running layer i, which upto=i
+                    # excludes)
+                    xb = self._apply_preprocessor(i, xb, batch_n)
+                    k = rng_mod.step_key(
+                        rng_mod.layer_key(self._rng, i, "sample"), it_count
+                    )
+                    self.params[i], lu_state = pretrain_step(
+                        self.params[i],
+                        lu_state,
+                        xb,
+                        jnp.asarray(it_count, jnp.int32),
+                        k,
+                    )
+                    it_count += 1
+            logger.info("pretrained layer %d (%s)", i, type(lc).__name__)
+
+    # ------------------------------------------------------------- inference
+    def output(self, x) -> jax.Array:
+        """Batch inference (reference output(INDArray) :619-704)."""
+        fn = self._get_output_fn()
+        return fn(self.params, self.states, jnp.asarray(x))
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (reference feedForward(train)). train=True
+        applies dropout (fresh step key) and batch-stats normalization."""
+        rng = rng_mod.step_key(self._rng, self.iteration) if train else None
+        acts, _ = self._forward(
+            self.params, self.states, jnp.asarray(x), train=train, rng=rng
+        )
+        return acts
+
+    def score(self, features, labels, mask=None, label_mask=None) -> float:
+        fn = self._get_score_fn(mask is not None, label_mask is not None)
+        return float(
+            fn(self.params, self.states, jnp.asarray(features), jnp.asarray(labels), mask, label_mask)
+        )
+
+    def evaluate(self, iterator):
+        """Evaluate over an iterator (reference evaluate(DataSetIterator) :2316)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(out), mask=ds.labels_mask)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return ev
+
+    # ------------------------------------------------- stateful rnn streaming
+    def rnn_clear_previous_state(self):
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "step"):
+                p, s, _ = layer.initialize(
+                    rng_mod.layer_key(self._rng, i, "init"), self._layer_input_shape(i)
+                )
+                self.states[i] = s
+
+    def _layer_input_shape(self, i):
+        # recompute shapes chain (cheap, static)
+        shape = self._input_shape
+        for j in range(i):
+            pp = self.conf.input_preprocessors.get(j)
+            if pp is not None:
+                shape = pp.out_shape(shape)
+            _, _, shape = self.layers[j].initialize(
+                rng_mod.layer_key(self._rng, j, "init"), shape
+            )
+        pp = self.conf.input_preprocessors.get(i)
+        return pp.out_shape(shape) if pp is not None else shape
+
+    def rnn_time_step(self, x_t) -> jax.Array:
+        """One-timestep stateful inference (reference rnnTimeStep :2152).
+        x_t: [N, F] (single step) or [N, T, F] (processed stepwise)."""
+        x_t = jnp.asarray(x_t)
+        if x_t.ndim == 3:
+            outs = [self.rnn_time_step(x_t[:, t]) for t in range(x_t.shape[1])]
+            return jnp.stack(outs, axis=1)
+        x = x_t
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "step"):
+                y, self.states[i] = layer.step(self.params[i], self.states[i], x)
+            else:
+                y, _ = layer.apply(self.params[i], self.states[i], x, train=False)
+            x = y
+        return x
+
+    # ------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        if self.params is not None:
+            net._input_shape = self._input_shape
+            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+            net.states = jax.tree_util.tree_map(lambda a: a, self.states)
+            net.updater_state = jax.tree_util.tree_map(
+                lambda a: a, self.updater_state
+            )
+            net.iteration = self.iteration
+        return net

@@ -1,0 +1,12 @@
+"""Tensor substrate: dtype/RNG policy and the named-op registries.
+
+Stands in for the external ND4J layer the reference depends on
+(SURVEY.md L0: INDArray / Nd4j factory / OpExecutioner string-named ops).
+Here the "backend" is jax.numpy/XLA; what remains of ND4J's surface is the
+policy (dtypes, RNG determinism) and the string-named activation registry that
+the config DSL references (reference executes activations by name through the
+op factory: deeplearning4j-core/.../nn/layers/BaseLayer.java:369-372).
+"""
+
+from deeplearning4j_tpu.ops.dtypes import DtypePolicy, get_policy, set_policy, float32_strict
+from deeplearning4j_tpu.ops.activations import activation, ACTIVATIONS

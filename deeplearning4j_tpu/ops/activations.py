@@ -1,0 +1,123 @@
+"""String-named activation registry.
+
+The reference configures activations by name and executes them through the
+ND4J op factory (deeplearning4j-core/.../nn/layers/BaseLayer.java:369-372
+``Nd4j.getOpFactory().createTransform(conf.getLayer().getActivationFunction(), input)``).
+We keep the string-named surface (it is the config-DSL contract) but each name
+maps to a pure jax function that XLA fuses into the surrounding program.
+
+Names mirror the reference-era set: sigmoid, tanh, relu, leakyrelu, softmax,
+identity/linear, softsign, softplus, hardtanh, cube, elu, rectifiedtanh,
+hardsigmoid, step — plus maxout is handled at the layer level.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+ACTIVATIONS: Dict[str, Callable[[Array], Array]] = {}
+
+
+def _register(*names):
+    def deco(fn):
+        for n in names:
+            ACTIVATIONS[n] = fn
+        return fn
+
+    return deco
+
+
+@_register("sigmoid")
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@_register("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@_register("relu")
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@_register("leakyrelu")
+def leakyrelu(x):
+    # reference LeakyReLU default alpha = 0.01
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+@_register("softmax")
+def softmax(x):
+    # row-wise softmax over the feature axis (last axis in our conventions)
+    return jax.nn.softmax(x, axis=-1)
+
+
+@_register("identity", "linear")
+def identity(x):
+    return x
+
+
+@_register("softsign")
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@_register("softplus")
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@_register("hardtanh")
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+@_register("hardsigmoid")
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+@_register("cube")
+def cube(x):
+    return x * x * x
+
+
+@_register("elu")
+def elu(x):
+    return jax.nn.elu(x)
+
+
+@_register("rectifiedtanh")
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+@_register("step")
+def step(x):
+    return jnp.where(x > 0.0, 1.0, 0.0)
+
+
+@_register("gelu")
+def gelu(x):  # not in the 2016 reference; standard for modern models
+    return jax.nn.gelu(x)
+
+
+@_register("swish", "silu")
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def activation(name: str) -> Callable[[Array], Array]:
+    try:
+        return ACTIVATIONS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}"
+        ) from None

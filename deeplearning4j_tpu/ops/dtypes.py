@@ -1,0 +1,65 @@
+"""Global dtype / numerics policy.
+
+The reference runs float32 (ND4J default) with op-by-op eager semantics. On
+TPU the MXU wants bfloat16 inputs with float32 accumulation; for the
+correctness bar ("CPU-equivalent loss curves", BASELINE.md) we need a strict
+float32 mode with highest-precision matmuls and deterministic reductions.
+
+Two modes:
+  - ``performance``: params float32, compute bfloat16, matmul precision default.
+  - ``strict``: everything float32, ``jax.default_matmul_precision('highest')``
+    applied by the training loop via :func:`float32_strict`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class DtypePolicy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+    # 'default' | 'float32' | 'highest' — passed to jax.default_matmul_precision
+    matmul_precision: str = "highest"
+
+    def cast_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_param(self, x):
+        return jnp.asarray(x, self.param_dtype)
+
+    def cast_output(self, x):
+        return jnp.asarray(x, self.output_dtype)
+
+
+STRICT = DtypePolicy()
+PERFORMANCE = DtypePolicy(compute_dtype=jnp.bfloat16, matmul_precision="default")
+
+_policy: DtypePolicy = STRICT
+
+
+def get_policy() -> DtypePolicy:
+    return _policy
+
+
+def set_policy(policy: DtypePolicy) -> None:
+    global _policy
+    _policy = policy
+
+
+@contextlib.contextmanager
+def float32_strict():
+    """Context for reference-equivalent numerics (the BASELINE north-star bar)."""
+    prev = _policy
+    set_policy(STRICT)
+    try:
+        with jax.default_matmul_precision("highest"):
+            yield
+    finally:
+        set_policy(prev)

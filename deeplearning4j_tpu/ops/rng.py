@@ -1,0 +1,37 @@
+"""Deterministic RNG streams.
+
+The reference seeds a single java RNG per configuration
+(NeuralNetConfiguration.Builder#seed). JAX uses splittable counter-based keys;
+we expose a small helper that derives named, per-layer, per-step streams so
+that weight init, dropout, and samplers (RBM Gibbs sampling) are reproducible
+and independent — designed early per SURVEY.md section 7 "Hard parts".
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Stable fold-in tags for the different stream kinds.
+_KIND_TAGS = {
+    "init": 0x1,
+    "dropout": 0x2,
+    "sample": 0x3,
+    "data": 0x4,
+    "noise": 0x5,
+}
+
+
+def key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def layer_key(base: jax.Array, layer_index: int, kind: str = "init") -> jax.Array:
+    """Derive the stream for (layer, kind). Stable across runs and jit."""
+    k = jax.random.fold_in(base, _KIND_TAGS[kind])
+    return jax.random.fold_in(k, layer_index)
+
+
+def step_key(base: jax.Array, step: jax.Array | int) -> jax.Array:
+    """Per-iteration stream (dropout etc.); `step` may be a traced scalar."""
+    return jax.random.fold_in(base, jnp.asarray(step, jnp.uint32))

@@ -1,0 +1,16 @@
+"""Optimization engine: updaters, LR schedules, solvers, listeners.
+
+Maps the reference's ``optimize/**`` + ``nn/updater/**``
+(SURVEY.md section 2.1: Solver, BaseOptimizer, StochasticGradientDescent,
+ConjugateGradient, LBFGS, BackTrackLineSearch; SGD/Adam/AdaGrad/AdaDelta/
+Nesterovs/RMSProp/NoOp updaters with LR decay policies and gradient
+normalization). Updaters are pure ``init/update`` transforms composed into
+the jitted train step; the Solver loop and listeners run host-side.
+"""
+
+from deeplearning4j_tpu.optimize.updaters import MultiLayerUpdater
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener,
+    IterationListener,
+    ScoreIterationListener,
+)

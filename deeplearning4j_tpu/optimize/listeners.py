@@ -1,0 +1,72 @@
+"""Iteration listeners — the observability hook chain.
+
+Mirrors the reference's ``IterationListener`` protocol invoked each optimizer
+iteration (StochasticGradientDescent.java:66-67) and the stock impls in
+optimize/listeners/: ScoreIterationListener, CollectScoresIterationListener,
+ParamAndGradientIterationListener.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, int(print_iterations))
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.print_iterations == 0:
+            logger.info("Score at iteration %d is %s", iteration, score)
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs every N iterations
+    (reference CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class PerformanceListener(IterationListener):
+    """Throughput tracking (samples/sec) — TPU-side equivalent of the Spark
+    stats instrumentation (SURVEY.md section 5 'Tracing/profiling')."""
+
+    def __init__(self, frequency: int = 10, batch_size: int = 0):
+        self.frequency = max(1, int(frequency))
+        self.batch_size = batch_size
+        self._last_time = None
+        self._last_iter = None
+
+    def iteration_done(self, model, iteration, score):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            n_iters = iteration - self._last_iter
+            if dt > 0 and n_iters > 0:
+                ips = n_iters / dt
+                msg = f"{ips:.1f} iter/s"
+                if self.batch_size:
+                    msg += f", {ips * self.batch_size:.1f} samples/s"
+                logger.info("iteration %d: %s (score %s)", iteration, msg, score)
+            self._last_time = now
+            self._last_iter = iteration
+        elif self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration

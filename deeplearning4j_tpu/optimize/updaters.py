@@ -1,0 +1,277 @@
+"""Updaters (per-parameter update rules) + LR policies + gradient normalization.
+
+Mirrors the reference's ``nn/updater`` package:
+  - BaseUpdater.update orchestrates per-variable updates
+    (deeplearning4j-core/.../nn/updater/BaseUpdater.java:35), LR decay
+    policies (:93-108), gradient normalization/clipping (:129-181);
+  - SgdUpdater, AdamUpdater, AdaGradUpdater, AdaDeltaUpdater,
+    NesterovsUpdater, RmsPropUpdater, NoOpUpdater; UpdaterCreator enum->impl
+    mapping (UpdaterCreator.java:23-44); MultiLayerUpdater aggregates
+    per-layer updaters.
+
+Design: each updater is a pure transform
+    init(params) -> state
+    update(grads, state, params, iteration) -> (updates, new_state)
+where ``updates`` is SUBTRACTED from params (the reference's default
+NegativeGradientStepFunction: params.subi(gradient)). Everything is
+jit-traceable; `iteration` may be a traced scalar (LR schedules use
+jnp.where chains, statically unrolled from the config dict).
+
+The reference applies the learning rate INSIDE the updater (gradient is
+scaled in-place), with a separate bias learning rate per parameter name —
+reproduced here via BIAS_PARAM_NAMES.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+BIAS_PARAM_NAMES = ("b", "vb", "beta")
+
+
+# ---------------------------------------------------------------------------
+# LR policies (reference LearningRatePolicy enum, BaseUpdater.java:93-108)
+# ---------------------------------------------------------------------------
+
+
+def lr_at(conf, base_lr: float, iteration) -> Array:
+    """Learning rate at `iteration` (traced ok) under the conf's lr policy.
+
+    conf carries: lr_policy, lr_policy_decay_rate, lr_policy_steps,
+    lr_policy_power, lr_schedule (dict iter->lr).
+    """
+    it = jnp.asarray(iteration, jnp.float32)
+    policy = getattr(conf, "lr_policy", "none") or "none"
+    decay = getattr(conf, "lr_policy_decay_rate", None)
+    steps = getattr(conf, "lr_policy_steps", None)
+    power = getattr(conf, "lr_policy_power", None)
+    if policy == "none" or policy == "score":
+        return jnp.asarray(base_lr, jnp.float32)
+    if policy == "exponential":
+        return base_lr * jnp.power(decay, it)
+    if policy == "inverse":
+        return base_lr / jnp.power(1.0 + decay * it, power)
+    if policy == "poly":
+        frac = jnp.clip(it / steps, 0.0, 1.0)
+        return base_lr * jnp.power(1.0 - frac, power)
+    if policy == "sigmoid":
+        return base_lr / (1.0 + jnp.exp(-decay * (it - steps)))
+    if policy == "step":
+        return base_lr * jnp.power(decay, jnp.floor(it / steps))
+    if policy == "schedule":
+        lr = jnp.asarray(base_lr, jnp.float32)
+        for k in sorted((conf.lr_schedule or {}).keys()):
+            lr = jnp.where(it >= k, conf.lr_schedule[k], lr)
+        return lr
+    raise ValueError(f"unknown lr policy {policy}")
+
+
+def momentum_at(layer_conf, net_conf, iteration) -> Array:
+    m = jnp.asarray(layer_conf.momentum, jnp.float32)
+    sched = getattr(net_conf, "momentum_schedule", None) if net_conf else None
+    if sched:
+        it = jnp.asarray(iteration, jnp.float32)
+        for k in sorted(sched.keys()):
+            m = jnp.where(it >= k, sched[k], m)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# gradient normalization (reference BaseUpdater.java:129-181)
+# ---------------------------------------------------------------------------
+
+
+def _global_norm(grads: Dict[str, Array]) -> Array:
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+    )
+
+
+def normalize_gradients(
+    grads: Dict[str, Array], scheme: Optional[str], threshold: float
+) -> Dict[str, Array]:
+    """Apply one layer's gradient normalization scheme to its grads dict."""
+    if not scheme:
+        return grads
+    s = scheme.lower()
+    if s == "renormalize_l2_per_layer":
+        norm = jnp.maximum(_global_norm(grads), 1e-12)
+        return jax.tree_util.tree_map(lambda g: g / norm, grads)
+    if s == "renormalize_l2_per_param_type":
+        # per-TENSOR norms; tree_map handles nested pytrees (e.g. biLSTM
+        # {'fwd': {...}, 'bwd': {...}})
+        return jax.tree_util.tree_map(
+            lambda g: g / jnp.maximum(jnp.linalg.norm(g.ravel()), 1e-12), grads
+        )
+    if s == "clip_elementwise_absolute_value":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -threshold, threshold), grads
+        )
+    if s == "clip_l2_per_layer":
+        norm = _global_norm(grads)
+        scale = jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    if s == "clip_l2_per_param_type":
+
+        def clip_leaf(g):
+            norm = jnp.linalg.norm(g.ravel())
+            scale = jnp.where(norm > threshold, threshold / (norm + 1e-12), 1.0)
+            return g * scale
+
+        return jax.tree_util.tree_map(clip_leaf, grads)
+    raise ValueError(f"unknown gradient normalization {scheme}")
+
+
+# ---------------------------------------------------------------------------
+# per-layer updaters
+# ---------------------------------------------------------------------------
+
+
+class LayerUpdater:
+    """Applies one layer's update rule to its params dict. Nested pytrees
+    (e.g. bidirectional LSTM {'fwd': {...}, 'bwd': {...}}) are handled by
+    operating leaf-wise with param-name-aware LR selection on the leaf key."""
+
+    def __init__(self, layer_conf, net_conf=None):
+        self.conf = layer_conf
+        self.net_conf = net_conf
+        self.kind = (layer_conf.updater or "sgd").lower()
+
+    # ---- state ------------------------------------------------------------
+    def init(self, params) -> Dict[str, Any]:
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        k = self.kind
+        if k in ("sgd", "none"):
+            return {}
+        if k == "nesterovs":
+            return {"v": zeros()}
+        if k == "adagrad":
+            return {"hist": zeros()}
+        if k == "rmsprop":
+            return {"cache": zeros()}
+        if k == "adadelta":
+            return {"msg": zeros(), "msdx": zeros()}
+        if k == "adam":
+            return {"m": zeros(), "v": zeros()}
+        raise ValueError(f"unknown updater {self.kind}")
+
+    # ---- the update rule, leaf-wise ---------------------------------------
+    def _lrs(self, params, iteration):
+        """Per-leaf learning rate tree (bias params get bias_learning_rate)."""
+        lr = lr_at(self.net_conf, self.conf.learning_rate, iteration)
+        bias_lr = lr_at(
+            self.net_conf,
+            self.conf.bias_learning_rate or self.conf.learning_rate,
+            iteration,
+        )
+
+        def leaf_lr(path, _):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            return bias_lr if name in BIAS_PARAM_NAMES else lr
+
+        return jax.tree_util.tree_map_with_path(leaf_lr, params)
+
+    def update(
+        self, grads, state, params, iteration
+    ) -> Tuple[Dict[str, Array], Dict[str, Any]]:
+        grads = normalize_gradients(
+            grads,
+            self.conf.gradient_normalization,
+            self.conf.gradient_normalization_threshold or 1.0,
+        )
+        lrs = self._lrs(params, iteration)
+        tmap = jax.tree_util.tree_map
+        k = self.kind
+        eps = self.conf.epsilon or 1e-8
+
+        if k == "sgd":
+            return tmap(lambda g, lr: g * lr, grads, lrs), state
+        if k == "none":
+            return grads, state
+        if k == "nesterovs":
+            mu = momentum_at(self.conf, self.net_conf, iteration)
+            v_prev = state["v"]
+            v_new = tmap(lambda v, g, lr: mu * v - lr * g, v_prev, grads, lrs)
+            # params -= (mu*v_prev - (1+mu)*v_new)  [NAG, reference NesterovsUpdater]
+            upd = tmap(lambda vp, vn: mu * vp - (1.0 + mu) * vn, v_prev, v_new)
+            return upd, {"v": v_new}
+        if k == "adagrad":
+            hist = tmap(lambda h, g: h + g * g, state["hist"], grads)
+            upd = tmap(
+                lambda g, h, lr: lr * g / (jnp.sqrt(h) + eps), grads, hist, lrs
+            )
+            return upd, {"hist": hist}
+        if k == "rmsprop":
+            d = self.conf.rms_decay
+            cache = tmap(
+                lambda c, g: d * c + (1.0 - d) * g * g, state["cache"], grads
+            )
+            upd = tmap(
+                lambda g, c, lr: lr * g / jnp.sqrt(c + eps), grads, cache, lrs
+            )
+            return upd, {"cache": cache}
+        if k == "adadelta":
+            rho = self.conf.rho
+            msg = tmap(lambda m, g: rho * m + (1 - rho) * g * g, state["msg"], grads)
+            upd = tmap(
+                lambda g, m, dx: g * jnp.sqrt(dx + eps) / jnp.sqrt(m + eps),
+                grads,
+                msg,
+                state["msdx"],
+            )
+            msdx = tmap(
+                lambda d_, u: rho * d_ + (1 - rho) * u * u, state["msdx"], upd
+            )
+            return upd, {"msg": msg, "msdx": msdx}
+        if k == "adam":
+            b1 = self.conf.adam_mean_decay
+            b2 = self.conf.adam_var_decay
+            t = jnp.asarray(iteration, jnp.float32) + 1.0
+            m = tmap(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+            v = tmap(lambda v_, g: b2 * v_ + (1 - b2) * g * g, state["v"], grads)
+            alpha = jnp.sqrt(1.0 - b2**t) / (1.0 - b1**t)
+            upd = tmap(
+                lambda m_, v_, lr: lr * alpha * m_ / (jnp.sqrt(v_) + eps),
+                m,
+                v,
+                lrs,
+            )
+            return upd, {"m": m, "v": v}
+        raise ValueError(f"unknown updater {self.kind}")
+
+
+class MultiLayerUpdater:
+    """Aggregates per-layer updaters over the network's list-of-dicts param
+    pytree (reference nn/updater/MultiLayerUpdater.java)."""
+
+    def __init__(self, layer_confs, net_conf=None):
+        self.updaters = [LayerUpdater(lc, net_conf) for lc in layer_confs]
+
+    def init(self, params_list):
+        return [u.init(p) for u, p in zip(self.updaters, params_list)]
+
+    def update(self, grads_list, state_list, params_list, iteration):
+        updates, new_states = [], []
+        for u, g, s, p in zip(self.updaters, grads_list, state_list, params_list):
+            if not g:  # parameterless layer
+                updates.append(g)
+                new_states.append(s)
+                continue
+            upd, ns = u.update(g, s, p, iteration)
+            updates.append(upd)
+            new_states.append(ns)
+        return updates, new_states
+
+
+def apply_updates(params_list, updates_list, minimize: bool = True):
+    """params <- params -/+ updates (reference StepFunction: negative step for
+    minimization, StochasticGradientDescent.java:60-64)."""
+    sign = -1.0 if minimize else 1.0
+    return jax.tree_util.tree_map(
+        lambda p, u: p + sign * u, params_list, updates_list
+    )

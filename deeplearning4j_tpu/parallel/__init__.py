@@ -1,0 +1,27 @@
+"""Distributed / data-parallel training engine.
+
+Replaces all three of the reference's data-parallel flavors (SURVEY.md
+section 2.7) with ONE mesh-based engine:
+
+  reference mechanism                          -> here
+  --------------------------------------------------------------------
+  ParallelWrapper (threads + param averaging,   ParallelWrapper: batch
+    core/.../parallelism/ParallelWrapper.java)    sharded over a Mesh, jit
+                                                  auto-partitions (GSPMD),
+                                                  gradients pmean over ICI
+  ParameterAveragingTrainingMaster (Spark       ParameterAveragingTrainer:
+    broadcast + RDD.aggregate,                    shard_map local steps +
+    dl4j-spark/.../ParameterAveragingTraining-    param/updater pmean every
+    Master.java:402-434)                          k minibatches (exact
+                                                  reference semantics)
+  Akka/Hazelcast Hogwild (legacy)               not reproduced (superseded)
+
+Multi-host: the same Mesh spans hosts via jax.distributed; collectives ride
+ICI within a slice and DCN across slices — no Spark/Akka control plane.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import device_mesh
+from deeplearning4j_tpu.parallel.data_parallel import (
+    ParallelWrapper,
+    ParameterAveragingTrainer,
+)

@@ -1,0 +1,205 @@
+"""Data-parallel trainers.
+
+Two modes, matching the reference's two semantics (SURVEY.md section 2.7):
+
+1. :class:`ParallelWrapper` — synchronous gradient data parallelism. The
+   batch is sharded over the mesh's data axis; params are replicated; the
+   network's ordinary jitted train step is executed under GSPMD, which
+   partitions the forward/backward and inserts the gradient all-reduce
+   (psum over ICI) automatically. Numerically identical to single-device
+   large-batch training. This supersedes the reference ParallelWrapper's
+   replica threads + periodic averaging
+   (core/.../parallelism/ParallelWrapper.java:58-95) with a strictly
+   stronger (every-step, gradient-level) sync at wire speed.
+
+2. :class:`ParameterAveragingTrainer` — exact reference semantics for the
+   Spark ParameterAveragingTrainingMaster
+   (dl4j-spark/.../paramavg/ParameterAveragingTrainingMaster.java:402-434):
+   N workers train INDEPENDENTLY for `averaging_frequency` minibatches from
+   the same broadcast params, then parameters AND updater state are averaged
+   (:416-434 averages both). Implemented with shard_map: each device is a
+   "worker", local steps run unsynced, then pmean replaces the
+   broadcast+RDD.aggregate round trip. The distributed==serial equivalence
+   test (TestCompareParameterAveragingSparkVsSingleMachine.java:115-262)
+   is mirrored in tests/test_data_parallel.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from deeplearning4j_tpu.ops import rng as rng_mod
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, device_mesh
+from deeplearning4j_tpu.optimize.updaters import apply_updates
+
+
+class ParallelWrapper:
+    """Synchronous gradient DP via batch sharding + GSPMD."""
+
+    def __init__(self, net, num_devices: Optional[int] = None, mesh: Optional[Mesh] = None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else device_mesh(num_devices)
+        self.n = int(np.prod(self.mesh.devices.shape))
+        self.data_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        self.repl = NamedSharding(self.mesh, P())
+        self._placed = False
+
+    def _place_model(self):
+        if self._placed:
+            return
+        if self.net.params is None:
+            self.net.init()
+        put = lambda t: jax.device_put(t, self.repl)
+        self.net.params = put(self.net.params)
+        self.net.states = put(self.net.states)
+        self.net.updater_state = put(self.net.updater_state)
+        self._placed = True
+
+    def fit(self, features, labels, mask=None, label_mask=None) -> float:
+        """One data-parallel train step across the mesh."""
+        self._place_model()
+        b = np.asarray(features).shape[0]
+        if b % self.n != 0:
+            raise ValueError(
+                f"batch {b} not divisible by {self.n} devices "
+                "(pad or trim — static shapes keep the step compiled once)"
+            )
+        net = self.net
+        x = jax.device_put(jnp.asarray(features), self.data_sharding)
+        y = jax.device_put(jnp.asarray(labels), self.data_sharding)
+        m = None if mask is None else jax.device_put(jnp.asarray(mask), self.data_sharding)
+        lm = None if label_mask is None else jax.device_put(jnp.asarray(label_mask), self.data_sharding)
+        step = net._get_train_step(m is not None, lm is not None)
+        srng = rng_mod.step_key(net._rng, net.iteration)
+        net.params, net.states, net.updater_state, loss = step(
+            net.params, net.states, net.updater_state, x, y,
+            jnp.asarray(net.iteration, jnp.int32), srng, m, lm,
+        )
+        net._record_iteration(loss)
+        return loss
+
+    def fit_iterator(self, iterator, num_epochs: int = 1):
+        for _ in range(num_epochs):
+            for ds in iterator:
+                self.fit(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        return self.net
+
+
+class ParameterAveragingTrainer:
+    """Reference-exact parameter averaging over mesh 'workers'.
+
+    Semantics (ParameterAveragingTrainingMaster.java):
+      - split each global batch into `n` worker shards of
+        `batch_size_per_worker` examples x `averaging_frequency` minibatches;
+      - every worker runs `averaging_frequency` INDEPENDENT train steps from
+        the same starting params (processMinibatch on executors,
+        ExecuteWorkerFlatMap.java:35-100);
+      - params and updater state are then averaged (:407-434).
+    """
+
+    def __init__(
+        self,
+        net,
+        num_workers: Optional[int] = None,
+        averaging_frequency: int = 5,
+        save_updater: bool = True,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.net = net
+        self.mesh = mesh if mesh is not None else device_mesh(num_workers)
+        self.n = int(np.prod(self.mesh.devices.shape))
+        self.averaging_frequency = max(1, int(averaging_frequency))
+        self.save_updater = save_updater
+        self._step_fn = None
+
+    def _build_step(self):
+        """shard_map worker: local minibatch loop, then pmean of params (+
+        updater state if save_updater — reference saveUpdater flag)."""
+        net = self.net
+        freq = self.averaging_frequency
+        save_updater = self.save_updater
+
+        def worker(params, states, upd_state, xs, ys, iteration, rngs):
+            # xs: [freq, local_b, ...] — this worker's minibatch sequence
+            def body(carry, inp):
+                params, states, upd_state, it = carry
+                x, r = inp
+
+                def loss_fn(p):
+                    return net._loss(
+                        p, states, x[0], x[1], train=True, rng=r, mask=None,
+                        label_mask=None,
+                    )
+
+                (loss, new_states), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                updates, upd_state2 = net.updater.update(
+                    grads, upd_state, params, it
+                )
+                params = apply_updates(params, updates, net.conf.minimize)
+                return (params, new_states, upd_state2, it + 1), loss
+
+            (params, states, upd_state, _), losses = jax.lax.scan(
+                body, (params, states, upd_state, iteration), ((xs, ys), rngs)
+            )
+            # averaging round: params (and updater state) pmean'd over workers
+            params = jax.lax.pmean(params, DATA_AXIS)
+            if save_updater:
+                upd_state = jax.lax.pmean(upd_state, DATA_AXIS)
+            states = jax.lax.pmean(states, DATA_AXIS)
+            return params, states, upd_state, jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+
+        repl = P()
+        sharded = P(None, DATA_AXIS)  # [freq, global_b, ...] split on batch axis
+        fn = shard_map(
+            worker,
+            mesh=self.mesh,
+            in_specs=(repl, repl, repl, sharded, sharded, repl, P(None)),
+            out_specs=(repl, repl, repl, repl),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    def fit(self, features, labels) -> float:
+        """One averaging round: features [freq*n*b, ...] or [freq, n*b, ...]."""
+        net = self.net
+        if net.params is None:
+            net.init()
+        x = jnp.asarray(features)
+        y = jnp.asarray(labels)
+        if x.ndim >= 2 and x.shape[0] != self.averaging_frequency:
+            # split flat batch into freq minibatches
+            gb = x.shape[0] // self.averaging_frequency
+            x = x[: gb * self.averaging_frequency].reshape(
+                (self.averaging_frequency, gb) + x.shape[1:]
+            )
+            y = y[: gb * self.averaging_frequency].reshape(
+                (self.averaging_frequency, gb) + y.shape[1:]
+            )
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        rngs = jax.vmap(lambda i: rng_mod.step_key(net._rng, i))(
+            jnp.arange(net.iteration, net.iteration + self.averaging_frequency)
+        )
+        net.params, net.states, net.updater_state, loss = self._step_fn(
+            net.params,
+            net.states,
+            net.updater_state,
+            x,
+            y,
+            jnp.asarray(net.iteration, jnp.int32),
+            rngs,
+        )
+        net.iteration += self.averaging_frequency
+        net.score_value = loss
+        return loss

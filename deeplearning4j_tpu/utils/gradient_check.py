@@ -1,0 +1,114 @@
+"""Numerical gradient checking — the correctness backbone.
+
+Mirrors the reference's ``GradientCheckUtil.checkGradients``
+(deeplearning4j-core/.../gradientcheck/GradientCheckUtil.java:51-123):
+central-difference numerical gradient vs the analytic (here: autodiff)
+gradient, per parameter, with a relative-error threshold, in float64.
+
+In the reference this validates hand-written backprop; here it validates the
+loss/forward plumbing (masking, regularization, fused softmax losses) against
+brute-force finite differences — the same role as the test gate (SURVEY.md
+section 4 "Numerical correctness").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients(
+    loss_fn: Callable,
+    params,
+    epsilon: float = 1e-6,
+    max_rel_error: float = 1e-3,
+    abs_error_floor: float = 1e-8,
+    max_params_per_leaf: Optional[int] = None,
+    seed: int = 0,
+    verbose: bool = False,
+) -> Tuple[bool, float]:
+    """Compare autodiff grads of `loss_fn(params)` with central differences.
+
+    Runs in float64 (enable jax_enable_x64 in tests — the reference enforces
+    double precision for gradient checks too).
+
+    max_params_per_leaf: if set, check a random subset per tensor (for big
+    nets); reference checks every parameter on tiny nets.
+
+    Returns (passed, max_relative_error).
+    """
+    params64 = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float64), params)
+    analytic = jax.grad(loss_fn)(params64)
+
+    leaves, treedef = jax.tree_util.tree_flatten(params64)
+    grad_leaves = jax.tree_util.tree_flatten(analytic)[0]
+    rng = np.random.default_rng(seed)
+    max_rel = 0.0
+    ok = True
+
+    for li, (leaf, gleaf) in enumerate(zip(leaves, grad_leaves)):
+        flat = np.asarray(leaf, dtype=np.float64).ravel()
+        gflat = np.asarray(gleaf, dtype=np.float64).ravel()
+        idxs = np.arange(flat.size)
+        if max_params_per_leaf is not None and flat.size > max_params_per_leaf:
+            idxs = rng.choice(flat.size, size=max_params_per_leaf, replace=False)
+        for j in idxs:
+            orig = flat[j]
+
+            def eval_at(v):
+                f2 = flat.copy()
+                f2[j] = v
+                new_leaves = list(leaves)
+                new_leaves[li] = jnp.asarray(f2.reshape(leaf.shape))
+                return float(loss_fn(jax.tree_util.tree_unflatten(treedef, new_leaves)))
+
+            num = (eval_at(orig + epsilon) - eval_at(orig - epsilon)) / (2 * epsilon)
+            ana = gflat[j]
+            denom = abs(num) + abs(ana)
+            if denom < abs_error_floor:
+                continue
+            rel = abs(num - ana) / denom
+            max_rel = max(max_rel, rel)
+            if rel > max_rel_error:
+                ok = False
+                if verbose:
+                    print(
+                        f"grad check FAIL leaf {li} idx {j}: "
+                        f"numerical={num:.8g} analytic={ana:.8g} rel={rel:.3g}"
+                    )
+    return ok, max_rel
+
+
+def check_network_gradients(
+    net,
+    features,
+    labels,
+    mask=None,
+    label_mask=None,
+    epsilon: float = 1e-6,
+    max_rel_error: float = 1e-3,
+    max_params_per_leaf: Optional[int] = None,
+) -> Tuple[bool, float]:
+    """Gradient-check a MultiLayerNetwork's full loss (incl. l1/l2) — the
+    MLN variant of GradientCheckUtil (reference :51-123)."""
+    if net.params is None:
+        net.init()
+    x = jnp.asarray(features, jnp.float64)
+    y = jnp.asarray(labels, jnp.float64)
+
+    def loss(p):
+        val, _ = net._loss(
+            p, net.states, x, y, train=False, rng=None, mask=mask, label_mask=label_mask
+        )
+        return val
+
+    return check_gradients(
+        loss,
+        net.params,
+        epsilon=epsilon,
+        max_rel_error=max_rel_error,
+        max_params_per_leaf=max_params_per_leaf,
+    )

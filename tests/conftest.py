@@ -1,0 +1,29 @@
+"""Test env: force a virtual 8-device CPU platform BEFORE jax initializes.
+
+Mirrors the reference's distributed-without-a-cluster test strategy
+(SURVEY.md section 4: Spark local[N] in BaseSparkTest.java:90) — multi-chip
+logic is tested on a virtual CPU mesh via
+XLA_FLAGS=--xla_force_host_platform_device_count=8.
+
+float64 is enabled for the gradient-check suite (the reference enforces
+double precision there, GradientCheckUtil.java).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell env may point at a TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon already in the env, so the env vars above are too late
+# for jax's import-time config read — set the config directly (backends have
+# not initialized yet when conftest runs).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,61 @@
+"""Activation registry unit tests (reference-style: tiny fixed inputs,
+hand-computed expectations — SURVEY.md section 4 'Layer unit tests')."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.activations import ACTIVATIONS, activation
+
+
+X = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], dtype=np.float32)
+
+
+@pytest.mark.parametrize(
+    "name,expected",
+    [
+        ("relu", np.maximum(X, 0)),
+        ("identity", X),
+        ("tanh", np.tanh(X)),
+        ("sigmoid", 1 / (1 + np.exp(-X))),
+        ("hardtanh", np.clip(X, -1, 1)),
+        ("cube", X**3),
+        ("softplus", np.log1p(np.exp(X))),
+        ("softsign", X / (1 + np.abs(X))),
+        ("leakyrelu", np.where(X > 0, X, 0.01 * X)),
+        ("step", (X > 0).astype(np.float32)),
+    ],
+)
+def test_pointwise_values(name, expected):
+    # rtol 1e-4: XLA's vectorized transcendental approximations (e.g. tanh)
+    # differ from libm at ~2e-5 relative
+    np.testing.assert_allclose(activation(name)(X), expected, rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_rows_sum_to_one():
+    x = np.random.default_rng(0).standard_normal((4, 7)).astype(np.float32)
+    y = np.asarray(activation("softmax")(x))
+    np.testing.assert_allclose(y.sum(axis=-1), np.ones(4), rtol=1e-6)
+    assert (y > 0).all()
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        activation("nope")
+
+
+def test_registry_contains_reference_era_set():
+    for name in [
+        "sigmoid",
+        "tanh",
+        "relu",
+        "leakyrelu",
+        "softmax",
+        "identity",
+        "softsign",
+        "softplus",
+        "hardtanh",
+        "cube",
+        "elu",
+        "rectifiedtanh",
+    ]:
+        assert name in ACTIVATIONS

@@ -1,0 +1,144 @@
+"""Config builder + JSON round-trip tests (reference
+NeuralNetConfigurationTest / MultiLayerNeuralNetConfigurationTest pattern:
+builder -> JSON -> rebuild -> equality — SURVEY.md section 4)."""
+
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+)
+
+
+def mlp_conf():
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .learning_rate(0.15)
+        .updater("nesterovs")
+        .momentum(0.9)
+        .l2(1e-4)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(
+            1,
+            OutputLayer(
+                n_in=8, n_out=3, activation="softmax", loss_function="mcxent"
+            ),
+        )
+        .backprop(True)
+        .pretrain(False)
+        .build()
+    )
+
+
+def test_builder_inheritance():
+    conf = mlp_conf()
+    assert conf.layers[0].learning_rate == 0.15
+    assert conf.layers[0].updater == "nesterovs"
+    assert conf.layers[0].momentum == 0.9
+    assert conf.layers[0].l2 == 1e-4
+    assert conf.layers[0].activation == "tanh"  # layer overrides global
+    assert conf.layers[1].activation == "softmax"
+    assert conf.seed == 42
+
+
+def test_layer_override_beats_global():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .learning_rate(0.1)
+        .list()
+        .layer(0, DenseLayer(n_in=2, n_out=2, learning_rate=0.9))
+        .layer(1, OutputLayer(n_in=2, n_out=2))
+        .build()
+    )
+    assert conf.layers[0].learning_rate == 0.9
+    assert conf.layers[1].learning_rate == 0.1
+
+
+def test_json_round_trip_mlp():
+    conf = mlp_conf()
+    j = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(j)
+    assert conf2.to_json() == j
+    assert conf2.layers[0] == conf.layers[0]
+    assert conf2.layers[1] == conf.layers[1]
+    assert conf2.seed == conf.seed
+
+
+def test_json_round_trip_cnn_with_preprocessors():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .list()
+        .layer(
+            0,
+            ConvolutionLayer(
+                n_in=1,
+                n_out=6,
+                kernel_size=(5, 5),
+                stride=(1, 1),
+                activation="relu",
+            ),
+        )
+        .layer(1, SubsamplingLayer(pooling_type="max", kernel_size=(2, 2)))
+        .layer(2, OutputLayer(n_in=864, n_out=10, activation="softmax"))
+        .input_preprocessor(2, CnnToFeedForwardPreProcessor(12, 12, 6))
+        .build()
+    )
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2.to_json() == conf.to_json()
+    assert isinstance(conf2.input_preprocessors[2], CnnToFeedForwardPreProcessor)
+    assert conf2.layers[0].kernel_size == (5, 5)
+
+
+def test_json_round_trip_rnn_tbptt():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(0, GravesLSTM(n_in=10, n_out=20, activation="tanh"))
+        .layer(1, RnnOutputLayer(n_in=20, n_out=5, activation="softmax"))
+        .backprop_type("truncated_bptt")
+        .t_bptt_forward_length(15)
+        .t_bptt_backward_length(15)
+        .build()
+    )
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2.backprop_type == "truncated_bptt"
+    assert conf2.tbptt_fwd_length == 15
+    assert conf2.layers[0] == conf.layers[0]
+
+
+def test_lr_schedule_round_trip():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .learning_rate(0.1)
+        .learning_rate_schedule({100: 0.01, 200: 0.001})
+        .list()
+        .layer(0, OutputLayer(n_in=2, n_out=2))
+        .build()
+    )
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2.lr_schedule == {100: 0.01, 200: 0.001}
+    assert conf2.lr_policy == "schedule"
+
+
+def test_missing_layer_index_raises():
+    with pytest.raises(ValueError):
+        (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(0, DenseLayer(n_in=2, n_out=2))
+            .layer(2, OutputLayer(n_in=2, n_out=2))
+            .build()
+        )

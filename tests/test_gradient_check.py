@@ -1,0 +1,157 @@
+"""Gradient-check suite — the numerical-correctness backbone
+(reference: GradientCheckTests, CNNGradientCheckTest, BNGradientCheckTest,
+GradientCheckTestsMasking — SURVEY.md section 4). Validates the loss/forward
+plumbing (losses, masking, regularization, conv, recurrence) against central
+differences in float64."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    GRU,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+from deeplearning4j_tpu.utils.gradient_check import check_network_gradients
+
+RNG = np.random.default_rng(12345)
+
+
+def random_classification(n, nin, nout):
+    x = RNG.standard_normal((n, nin))
+    y = np.eye(nout)[RNG.integers(0, nout, n)]
+    return x, y
+
+
+@pytest.mark.parametrize("activation", ["sigmoid", "tanh", "relu"])
+@pytest.mark.parametrize(
+    "loss,out_act",
+    [("mcxent", "softmax"), ("mse", "identity"), ("xent", "sigmoid")],
+)
+def test_mlp_gradients(activation, loss, out_act):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=5, activation=activation))
+        .layer(
+            1, OutputLayer(n_in=5, n_out=3, activation=out_act, loss_function=loss)
+        )
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x, y = random_classification(6, 4, 3)
+    ok, max_rel = check_network_gradients(net, x, y)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_mlp_gradients_with_l1_l2():
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .l1(0.01)
+        .l2(0.02)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=5, activation="tanh"))
+        .layer(1, OutputLayer(n_in=5, n_out=3, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x, y = random_classification(5, 4, 3)
+    ok, max_rel = check_network_gradients(net, x, y)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_cnn_gradients():
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .list()
+        .layer(
+            0,
+            ConvolutionLayer(
+                n_in=1, n_out=2, kernel_size=(2, 2), stride=(1, 1),
+                activation="tanh",
+            ),
+        )
+        .layer(1, SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(2, OutputLayer(n_in=8, n_out=2, activation="softmax"))
+        .input_preprocessor(2, CnnToFeedForwardPreProcessor(2, 2, 2))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init(input_shape=(5, 5, 1))
+    x = RNG.standard_normal((3, 5, 5, 1))
+    y = np.eye(2)[RNG.integers(0, 2, 3)]
+    ok, max_rel = check_network_gradients(net, x, y, max_params_per_leaf=20)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_lstm_gradients():
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .list()
+        .layer(0, GravesLSTM(n_in=3, n_out=4, activation="tanh"))
+        .layer(1, RnnOutputLayer(n_in=4, n_out=2, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.standard_normal((2, 4, 3))
+    y = np.eye(2)[RNG.integers(0, 2, (2, 4))]
+    ok, max_rel = check_network_gradients(net, x, y, max_params_per_leaf=25)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_gru_gradients():
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(8)
+        .list()
+        .layer(0, GRU(n_in=3, n_out=4, activation="tanh"))
+        .layer(1, RnnOutputLayer(n_in=4, n_out=2, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.standard_normal((2, 4, 3))
+    y = np.eye(2)[RNG.integers(0, 2, (2, 4))]
+    ok, max_rel = check_network_gradients(net, x, y, max_params_per_leaf=25)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_rnn_masked_gradients():
+    """Masked-timestep gradients (reference GradientCheckTestsMasking)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(9)
+        .list()
+        .layer(0, GravesLSTM(n_in=2, n_out=3, activation="tanh"))
+        .layer(1, RnnOutputLayer(n_in=3, n_out=2, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.standard_normal((2, 5, 2))
+    y = np.eye(2)[RNG.integers(0, 2, (2, 5))]
+    mask = np.array([[1, 1, 1, 1, 1], [1, 1, 1, 0, 0]], dtype=np.float64)
+    ok, max_rel = check_network_gradients(
+        net, x, y, mask=jnp.asarray(mask), max_params_per_leaf=25
+    )
+    assert ok, f"max relative error {max_rel}"

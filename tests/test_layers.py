@@ -1,0 +1,237 @@
+"""Layer unit tests: tiny fixed inputs, numpy-verified forwards
+(reference pattern: ConvolutionLayerTest, GravesLSTMTest,
+BatchNormalizationTest, EmbeddingLayerTest — SURVEY.md section 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    EmbeddingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    GRU,
+    LocalResponseNormalization,
+    SubsamplingLayer,
+    resolve,
+)
+from deeplearning4j_tpu.nn.layers.factory import create_layer
+
+KEY = jax.random.PRNGKey(0)
+
+
+def build(conf, input_shape):
+    layer = create_layer(resolve(conf))
+    params, state, out_shape = layer.initialize(KEY, input_shape)
+    return layer, params, state, out_shape
+
+
+def test_dense_forward_matches_numpy():
+    layer, params, state, out_shape = build(
+        DenseLayer(n_in=3, n_out=4, activation="tanh"), (3,)
+    )
+    x = np.random.default_rng(0).standard_normal((5, 3)).astype(np.float32)
+    y, _ = layer.apply(params, state, jnp.asarray(x))
+    expected = np.tanh(x @ np.asarray(params["W"]) + np.asarray(params["b"]))
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-5)
+    assert out_shape == (4,)
+
+
+def test_dense_dropout_train_vs_inference():
+    layer, params, state, _ = build(
+        DenseLayer(n_in=10, n_out=10, activation="identity", dropout=0.5), (10,)
+    )
+    x = jnp.ones((4, 10))
+    y_inf, _ = layer.apply(params, state, x, train=False)
+    y_tr, _ = layer.apply(params, state, x, train=True, rng=jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(y_inf), np.asarray(y_tr))
+
+
+def test_conv_shapes_and_identity_kernel():
+    layer, params, state, out_shape = build(
+        ConvolutionLayer(
+            n_in=1, n_out=1, kernel_size=(3, 3), stride=(1, 1), padding=(1, 1),
+            activation="identity", weight_init="zero",
+        ),
+        (5, 5, 1),
+    )
+    assert out_shape == (5, 5, 1)
+    # delta kernel -> identity map
+    W = np.zeros((3, 3, 1, 1), np.float32)
+    W[1, 1, 0, 0] = 1.0
+    params = {"W": jnp.asarray(W), "b": params["b"]}
+    x = np.random.default_rng(0).standard_normal((2, 5, 5, 1)).astype(np.float32)
+    y, _ = layer.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(y), x, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_stride_no_padding_shape():
+    _, _, _, out_shape = build(
+        ConvolutionLayer(n_in=1, n_out=6, kernel_size=(5, 5), stride=(1, 1)),
+        (28, 28, 1),
+    )
+    assert out_shape == (24, 24, 6)
+
+
+def test_max_pooling_values():
+    layer, params, state, out_shape = build(
+        SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+        (4, 4, 1),
+    )
+    assert out_shape == (2, 2, 1)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(
+        np.asarray(y)[0, :, :, 0], [[5.0, 7.0], [13.0, 15.0]]
+    )
+
+
+def test_avg_pooling_values():
+    layer, params, state, _ = build(
+        SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2)),
+        (2, 2, 1),
+    )
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]]).reshape(1, 2, 2, 1)
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(y).ravel(), [2.5])
+
+
+def test_batchnorm_normalizes_and_tracks_stats():
+    layer, params, state, _ = build(BatchNormalization(), (8,))
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((64, 8)) * 5 + 3.0
+    )
+    y, new_state = layer.apply(params, state, x, train=True)
+    assert abs(float(jnp.mean(y))) < 0.1
+    assert abs(float(jnp.std(y)) - 1.0) < 0.1
+    # running stats moved toward batch stats
+    assert float(jnp.max(jnp.abs(new_state["mean"]))) > 0
+    # inference path uses running stats (different result than train path)
+    y_inf, st2 = layer.apply(params, new_state, x, train=False)
+    assert np.all(np.asarray(st2["mean"]) == np.asarray(new_state["mean"]))
+
+
+def test_lrn_shape_preserved():
+    layer, params, state, out_shape = build(
+        LocalResponseNormalization(), (6, 6, 10)
+    )
+    assert out_shape == (6, 6, 10)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 6, 6, 10)))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == x.shape
+    # normalization shrinks magnitudes
+    assert float(jnp.max(jnp.abs(y))) <= float(jnp.max(jnp.abs(x)))
+
+
+def test_embedding_lookup():
+    layer, params, state, _ = build(
+        EmbeddingLayer(n_in=7, n_out=4, activation="identity"), (1,)
+    )
+    idx = jnp.asarray([[0], [3], [6]])
+    y, _ = layer.apply(params, state, idx)
+    expected = np.asarray(params["W"])[[0, 3, 6]] + np.asarray(params["b"])
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-6)
+
+
+def test_lstm_forward_shapes_and_forget_bias():
+    layer, params, state, out_shape = build(
+        GravesLSTM(n_in=3, n_out=5, activation="tanh"), (-1, 3)
+    )
+    assert out_shape == (-1, 5)
+    b = np.asarray(params["b"])
+    np.testing.assert_allclose(b[5:10], np.ones(5))  # forget gate bias = 1
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 7, 3)))
+    y, st = layer.apply(params, state, x)
+    assert y.shape == (2, 7, 5)
+    assert st["h"].shape == (2, 5) and st["c"].shape == (2, 5)
+
+
+def test_lstm_masking_freezes_state_and_zeroes_output():
+    layer, params, state, _ = build(
+        GravesLSTM(n_in=3, n_out=4, activation="tanh"), (-1, 3)
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((1, 6, 3)).astype(np.float32))
+    mask = jnp.asarray([[1, 1, 1, 0, 0, 0]], dtype=np.float32)
+    y, st = layer.apply(params, state, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(y)[0, 3:], 0.0)
+    # state after masked tail == state at t=2
+    y3, st3 = layer.apply(params, state, x[:, :3])
+    np.testing.assert_allclose(np.asarray(st["h"]), np.asarray(st3["h"]), rtol=1e-5)
+
+
+def test_lstm_step_matches_scan():
+    layer, params, state, _ = build(
+        GravesLSTM(n_in=3, n_out=4, activation="tanh"), (-1, 3)
+    )
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((2, 5, 3)).astype(np.float32))
+    y_scan, _ = layer.apply(params, state, x)
+    st = state
+    outs = []
+    for t in range(5):
+        o, st = layer.step(params, st, x[:, t])
+        outs.append(o)
+    y_step = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(y_scan), np.asarray(y_step), rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_lstm_uses_future_context():
+    layer, params, state, _ = build(
+        GravesBidirectionalLSTM(n_in=2, n_out=3, activation="tanh"), (-1, 2)
+    )
+    rng = np.random.default_rng(0)
+    x1 = rng.standard_normal((1, 5, 2)).astype(np.float32)
+    x2 = x1.copy()
+    x2[0, 4] += 1.0  # change only the LAST timestep
+    y1, _ = layer.apply(params, state, jnp.asarray(x1))
+    y2, _ = layer.apply(params, state, jnp.asarray(x2))
+    # output at t=0 must differ (backward pass sees the future)
+    assert not np.allclose(np.asarray(y1)[0, 0], np.asarray(y2)[0, 0])
+
+
+def test_gru_shapes_and_step_consistency():
+    layer, params, state, out_shape = build(
+        GRU(n_in=3, n_out=4, activation="tanh"), (-1, 3)
+    )
+    assert out_shape == (-1, 4)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 6, 3)).astype(np.float32))
+    y_scan, _ = layer.apply(params, state, x)
+    st = state
+    outs = []
+    for t in range(6):
+        o, st = layer.step(params, st, x[:, t])
+        outs.append(o)
+    np.testing.assert_allclose(
+        np.asarray(y_scan), np.asarray(jnp.stack(outs, axis=1)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_lstm_carry_state_resumes():
+    """TBPTT window chaining: two half-windows with carry == one full window
+    (reference doTruncatedBPTT state carry)."""
+    layer, params, state, _ = build(
+        GravesLSTM(n_in=3, n_out=4, activation="tanh"), (-1, 3)
+    )
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((2, 8, 3)).astype(np.float32))
+    y_full, _ = layer.apply(params, state, x)
+    y1, st1 = layer.apply(params, state, x[:, :4])
+    y2, _ = layer.apply(params, st1, x[:, 4:], carry_state=True)
+    np.testing.assert_allclose(
+        np.asarray(y_full), np.asarray(jnp.concatenate([y1, y2], axis=1)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_gru_carry_state_resumes():
+    layer, params, state, _ = build(GRU(n_in=3, n_out=4, activation="tanh"), (-1, 3))
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((2, 8, 3)).astype(np.float32))
+    y_full, _ = layer.apply(params, state, x)
+    y1, st1 = layer.apply(params, state, x[:, :4])
+    y2, _ = layer.apply(params, st1, x[:, 4:], carry_state=True)
+    np.testing.assert_allclose(
+        np.asarray(y_full), np.asarray(jnp.concatenate([y1, y2], axis=1)),
+        rtol=1e-5, atol=1e-6,
+    )

@@ -1,0 +1,147 @@
+"""Network integration tests (reference MultiLayerTest/BackPropMLPTest
+pattern: small nets on Iris/synthetic, assert score decreases, evaluation,
+serialization round-trip — SURVEY.md section 4)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.fetchers import IrisDataSetIterator, load_iris
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+
+def iris_net(seed=42, lr=0.1, updater="sgd"):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater(updater)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=10, activation="tanh"))
+        .layer(
+            1,
+            OutputLayer(
+                n_in=10, n_out=3, activation="softmax", loss_function="mcxent"
+            ),
+        )
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def test_score_decreases_on_iris():
+    net = iris_net()
+    x, y = load_iris()
+    s0 = net.score(x, y)
+    for _ in range(30):
+        net.fit(x, y)
+    s1 = net.score(x, y)
+    assert s1 < s0 * 0.7, f"score did not decrease enough: {s0} -> {s1}"
+
+
+def test_iris_accuracy_after_training():
+    net = iris_net(updater="adam", lr=0.05)
+    it = IrisDataSetIterator(batch=50)
+    net.fit_iterator(it, num_epochs=60)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.9, ev.stats()
+
+
+def test_listeners_invoked():
+    net = iris_net()
+    collector = CollectScoresIterationListener(frequency=1)
+    net.set_listeners(collector)
+    x, y = load_iris()
+    for _ in range(5):
+        net.fit(x, y)
+    assert len(collector.scores) == 5
+    assert collector.scores[0][1] > collector.scores[-1][1]
+
+
+def test_deterministic_same_seed():
+    x, y = load_iris()
+    n1, n2 = iris_net(seed=7), iris_net(seed=7)
+    for _ in range(3):
+        n1.fit(x, y)
+        n2.fit(x, y)
+    for p1, p2 in zip(n1.params, n2.params):
+        for k in p1:
+            np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+
+
+def test_different_seed_differs():
+    x, y = load_iris()
+    n1, n2 = iris_net(seed=1), iris_net(seed=2)
+    assert not np.allclose(
+        np.asarray(n1.params[0]["W"]), np.asarray(n2.params[0]["W"])
+    )
+
+
+def test_async_iterator_equivalent():
+    x, y = load_iris()
+    base = ListDataSetIterator(x, y, batch=50)
+    a = iris_net(seed=3)
+    b = iris_net(seed=3)
+    a.fit_iterator(base, num_epochs=2)
+    b.fit_iterator(AsyncDataSetIterator(ListDataSetIterator(x, y, batch=50), device_put=False), num_epochs=2)
+    for p1, p2 in zip(a.params, b.params):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-6
+            )
+
+
+def test_output_shape_and_probabilities():
+    net = iris_net()
+    x, _ = load_iris()
+    out = np.asarray(net.output(x[:10]))
+    assert out.shape == (10, 3)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(10), rtol=1e-5)
+
+
+def test_num_params():
+    net = iris_net()
+    # 4*10 + 10 + 10*3 + 3 = 83
+    assert net.num_params() == 83
+
+
+def test_model_serializer_round_trip(tmp_path):
+    net = iris_net(updater="adam")
+    x, y = load_iris()
+    for _ in range(5):
+        net.fit(x, y)
+    path = str(tmp_path / "model.zip")
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_multi_layer_network(path)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x[:8])), np.asarray(net2.output(x[:8])), rtol=1e-6
+    )
+    assert net2.iteration == net.iteration
+    # training continues identically (updater state restored)
+    net.fit(x, y)
+    net2.fit(x, y)
+    for p1, p2 in zip(net.params, net2.params):
+        for k in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[k]), np.asarray(p2[k]), rtol=1e-5
+            )
+
+
+def test_clone_independent():
+    net = iris_net()
+    x, y = load_iris()
+    c = net.clone()
+    net.fit(x, y)
+    assert not np.allclose(
+        np.asarray(net.params[0]["W"]), np.asarray(c.params[0]["W"])
+    )

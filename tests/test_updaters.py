@@ -1,0 +1,171 @@
+"""Updater closed-form tests (reference TestUpdaters.java pattern:
+hand-computed expected update per rule — SURVEY.md section 4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, resolve
+from deeplearning4j_tpu.optimize.updaters import (
+    LayerUpdater,
+    apply_updates,
+    lr_at,
+    normalize_gradients,
+)
+
+
+def make_updater(**kw):
+    conf = resolve(DenseLayer(n_in=2, n_out=2, **kw))
+    return LayerUpdater(conf), conf
+
+
+G = {"W": jnp.array([[1.0, -2.0], [0.5, 3.0]]), "b": jnp.array([0.1, -0.1])}
+P = {"W": jnp.zeros((2, 2)), "b": jnp.zeros(2)}
+
+
+def test_sgd():
+    u, conf = make_updater(updater="sgd", learning_rate=0.5)
+    upd, _ = u.update(G, u.init(P), P, 0)
+    np.testing.assert_allclose(upd["W"], 0.5 * np.asarray(G["W"]))
+    np.testing.assert_allclose(upd["b"], 0.5 * np.asarray(G["b"]))
+
+
+def test_bias_learning_rate():
+    u, _ = make_updater(updater="sgd", learning_rate=0.5, bias_learning_rate=0.1)
+    upd, _ = u.update(G, u.init(P), P, 0)
+    np.testing.assert_allclose(upd["W"], 0.5 * np.asarray(G["W"]))
+    np.testing.assert_allclose(upd["b"], 0.1 * np.asarray(G["b"]))
+
+
+def test_nesterov_two_steps():
+    lr, mu = 0.1, 0.9
+    u, _ = make_updater(updater="nesterovs", learning_rate=lr, momentum=mu)
+    state = u.init(P)
+    g = np.asarray(G["W"])
+    # step 1: v1 = -lr*g ; upd = mu*0 - (1+mu)*v1
+    upd1, state = u.update(G, state, P, 0)
+    v1 = -lr * g
+    np.testing.assert_allclose(upd1["W"], -(1 + mu) * v1, rtol=1e-6)
+    # step 2 with same gradient
+    upd2, state = u.update(G, state, P, 1)
+    v2 = mu * v1 - lr * g
+    np.testing.assert_allclose(upd2["W"], mu * v1 - (1 + mu) * v2, rtol=1e-6)
+
+
+def test_adagrad():
+    lr, eps = 0.5, 1e-8
+    u, _ = make_updater(updater="adagrad", learning_rate=lr, epsilon=eps)
+    upd, state = u.update(G, u.init(P), P, 0)
+    g = np.asarray(G["W"])
+    np.testing.assert_allclose(
+        upd["W"], lr * g / (np.sqrt(g * g) + eps), rtol=1e-6
+    )
+    # second step accumulates history
+    upd2, _ = u.update(G, state, P, 1)
+    np.testing.assert_allclose(
+        upd2["W"], lr * g / (np.sqrt(2 * g * g) + eps), rtol=1e-6
+    )
+
+
+def test_rmsprop():
+    lr, d, eps = 0.2, 0.95, 1e-8
+    u, _ = make_updater(updater="rmsprop", learning_rate=lr, rms_decay=d, epsilon=eps)
+    upd, _ = u.update(G, u.init(P), P, 0)
+    g = np.asarray(G["W"])
+    cache = (1 - d) * g * g
+    np.testing.assert_allclose(upd["W"], lr * g / np.sqrt(cache + eps), rtol=1e-6)
+
+
+def test_adadelta_first_step():
+    rho, eps = 0.95, 1e-6
+    u, _ = make_updater(updater="adadelta", rho=rho, epsilon=eps)
+    upd, _ = u.update(G, u.init(P), P, 0)
+    g = np.asarray(G["W"])
+    msg = (1 - rho) * g * g
+    expected = g * np.sqrt(eps) / np.sqrt(msg + eps)
+    np.testing.assert_allclose(upd["W"], expected, rtol=1e-5)
+
+
+def test_adam_first_step():
+    lr, b1, b2, eps = 0.1, 0.9, 0.999, 1e-8
+    u, _ = make_updater(
+        updater="adam",
+        learning_rate=lr,
+        adam_mean_decay=b1,
+        adam_var_decay=b2,
+        epsilon=eps,
+    )
+    upd, _ = u.update(G, u.init(P), P, 0)
+    g = np.asarray(G["W"])
+    m = (1 - b1) * g
+    v = (1 - b2) * g * g
+    alpha = np.sqrt(1 - b2) / (1 - b1)
+    np.testing.assert_allclose(
+        upd["W"], lr * alpha * m / (np.sqrt(v) + eps), rtol=1e-5
+    )
+
+
+def test_noop():
+    u, _ = make_updater(updater="none")
+    upd, _ = u.update(G, u.init(P), P, 0)
+    np.testing.assert_allclose(upd["W"], np.asarray(G["W"]))
+
+
+def test_apply_updates_minimize():
+    p2 = apply_updates([P], [G], minimize=True)
+    np.testing.assert_allclose(p2[0]["W"], -np.asarray(G["W"]))
+
+
+# -- LR policies (reference TestDecayPolicies.java pattern) ------------------
+
+
+class _Conf:
+    def __init__(self, **kw):
+        self.lr_policy = kw.get("lr_policy", "none")
+        self.lr_policy_decay_rate = kw.get("decay")
+        self.lr_policy_steps = kw.get("steps")
+        self.lr_policy_power = kw.get("power")
+        self.lr_schedule = kw.get("schedule")
+        self.momentum_schedule = None
+
+
+@pytest.mark.parametrize(
+    "conf,it,expected",
+    [
+        (_Conf(), 10, 0.1),
+        (_Conf(lr_policy="exponential", decay=0.9), 2, 0.1 * 0.9**2),
+        (_Conf(lr_policy="inverse", decay=0.5, power=2.0), 3, 0.1 / (1 + 0.5 * 3) ** 2),
+        (_Conf(lr_policy="step", decay=0.5, steps=10.0), 25, 0.1 * 0.5**2),
+        (_Conf(lr_policy="poly", power=2.0, steps=100.0), 50, 0.1 * 0.25),
+        (_Conf(lr_policy="schedule", schedule={5: 0.01, 10: 0.001}), 3, 0.1),
+        (_Conf(lr_policy="schedule", schedule={5: 0.01, 10: 0.001}), 7, 0.01),
+        (_Conf(lr_policy="schedule", schedule={5: 0.01, 10: 0.001}), 11, 0.001),
+    ],
+)
+def test_lr_policies(conf, it, expected):
+    np.testing.assert_allclose(float(lr_at(conf, 0.1, it)), expected, rtol=1e-6)
+
+
+# -- gradient normalization (reference TestGradientNormalization.java) ------
+
+
+def test_clip_elementwise():
+    out = normalize_gradients(G, "clip_elementwise_absolute_value", 1.0)
+    assert np.abs(np.asarray(out["W"])).max() <= 1.0
+
+
+def test_renormalize_l2_per_layer():
+    out = normalize_gradients(G, "renormalize_l2_per_layer", 1.0)
+    total = sum(np.sum(np.square(np.asarray(v))) for v in out.values())
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_clip_l2_per_param_type():
+    out = normalize_gradients(G, "clip_l2_per_param_type", 1.0)
+    for v in out.values():
+        assert np.linalg.norm(np.asarray(v).ravel()) <= 1.0 + 1e-5
+
+
+def test_clip_l2_noop_when_under_threshold():
+    out = normalize_gradients(G, "clip_l2_per_layer", 1e9)
+    np.testing.assert_allclose(out["W"], np.asarray(G["W"]))

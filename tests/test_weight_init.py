@@ -1,0 +1,86 @@
+"""Weight init distribution tests (reference WeightInitUtil.java:93-123 semantics)."""
+
+import numpy as np
+import jax
+import pytest
+
+from deeplearning4j_tpu.nn.weights import init_weights
+
+
+KEY = jax.random.PRNGKey(0)
+SHAPE = (200, 300)
+FAN_IN, FAN_OUT = SHAPE
+
+
+def test_zero():
+    w = init_weights(KEY, SHAPE, "zero", FAN_IN, FAN_OUT)
+    assert np.all(np.asarray(w) == 0)
+
+
+def test_xavier_std():
+    w = np.asarray(init_weights(KEY, SHAPE, "xavier", FAN_IN, FAN_OUT))
+    expected = 1.0 / np.sqrt(FAN_IN + FAN_OUT)
+    assert abs(w.std() - expected) / expected < 0.05
+    assert abs(w.mean()) < 3 * expected / np.sqrt(w.size)
+
+
+def test_relu_std():
+    w = np.asarray(init_weights(KEY, SHAPE, "relu", FAN_IN, FAN_OUT))
+    expected = np.sqrt(2.0 / FAN_IN)
+    assert abs(w.std() - expected) / expected < 0.05
+
+
+def test_uniform_range():
+    w = np.asarray(init_weights(KEY, SHAPE, "uniform", FAN_IN, FAN_OUT))
+    a = 1.0 / FAN_IN
+    assert w.min() >= -a and w.max() <= a
+    assert w.max() > 0.9 * a  # actually fills the range
+
+
+def test_vi_range():
+    w = np.asarray(init_weights(KEY, SHAPE, "vi", FAN_IN, FAN_OUT))
+    r = np.sqrt(6.0) / np.sqrt(sum(SHAPE) + 1)
+    assert w.min() >= -r and w.max() <= r
+
+
+def test_size_range():
+    w = np.asarray(init_weights(KEY, SHAPE, "size", FAN_IN, FAN_OUT))
+    r = 4.0 * np.sqrt(6.0 / (FAN_IN + FAN_OUT))
+    assert w.min() >= -r and w.max() <= r
+
+
+def test_normalized():
+    w = np.asarray(init_weights(KEY, SHAPE, "normalized", FAN_IN, FAN_OUT))
+    assert w.min() >= -0.5 / FAN_IN and w.max() <= 0.5 / FAN_IN
+
+
+def test_distribution_normal():
+    w = np.asarray(
+        init_weights(
+            KEY, SHAPE, "distribution", FAN_IN, FAN_OUT,
+            dist={"type": "normal", "mean": 1.0, "std": 0.1},
+        )
+    )
+    assert abs(w.mean() - 1.0) < 0.01
+    assert abs(w.std() - 0.1) < 0.01
+
+
+def test_distribution_uniform():
+    w = np.asarray(
+        init_weights(
+            KEY, SHAPE, "distribution", FAN_IN, FAN_OUT,
+            dist={"type": "uniform", "lower": 2.0, "upper": 3.0},
+        )
+    )
+    assert w.min() >= 2.0 and w.max() <= 3.0
+
+
+def test_determinism():
+    a = init_weights(KEY, SHAPE, "xavier", FAN_IN, FAN_OUT)
+    b = init_weights(KEY, SHAPE, "xavier", FAN_IN, FAN_OUT)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_unknown_scheme_raises():
+    with pytest.raises(ValueError):
+        init_weights(KEY, SHAPE, "bogus", FAN_IN, FAN_OUT)
