@@ -37,6 +37,20 @@ class DataSet:
         return int(np.asarray(self.features).shape[0])
 
 
+@dataclass
+class MultiDataSet:
+    """Multi-input / multi-output minibatch (reference org.nd4j MultiDataSet,
+    consumed by ComputationGraph.fit(MultiDataSet) — ComputationGraph.java:676)."""
+
+    features_list: List[np.ndarray]
+    labels_list: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(np.asarray(self.features_list[0]).shape[0])
+
+
 class DataSetIterator:
     """Iterator protocol. Python iteration + reset(), matching the reference's
     hasNext/next/reset surface."""

@@ -1,0 +1,39 @@
+"""Early stopping: configuration, terminations, savers, trainer.
+
+Mirrors the reference's ``earlystopping`` package (22 files, 1,525 LoC —
+SURVEY.md section 2.1): EarlyStoppingConfiguration + BaseEarlyStoppingTrainer
+epoch loop with score calculation, termination checks, and best-model saving
+(deeplearning4j-core/.../earlystopping/trainer/BaseEarlyStoppingTrainer.java:82-160).
+"""
+
+from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
+from deeplearning4j_tpu.earlystopping.result import EarlyStoppingResult
+from deeplearning4j_tpu.earlystopping.savers import (
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import DataSetLossCalculator
+from deeplearning4j_tpu.earlystopping.terminations import (
+    BestScoreEpochTerminationCondition,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+
+__all__ = [
+    "EarlyStoppingConfiguration",
+    "EarlyStoppingResult",
+    "EarlyStoppingTrainer",
+    "InMemoryModelSaver",
+    "LocalFileModelSaver",
+    "DataSetLossCalculator",
+    "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition",
+]

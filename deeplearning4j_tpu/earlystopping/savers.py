@@ -1,0 +1,73 @@
+"""Model savers (reference earlystopping/saver/).
+
+InMemoryModelSaver keeps clones in RAM; LocalFileModelSaver writes
+bestModel/latestModel checkpoints via ModelSerializer (reference
+LocalFileModelSaver.java writes bestModel.bin / latestModel.bin).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class InMemoryModelSaver:
+    """Keep best/latest model clones in memory (reference InMemoryModelSaver.java)."""
+
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self.best = net.clone()
+
+    def save_latest_model(self, net, score: float) -> None:
+        self.latest = net.clone()
+
+    def get_best_model(self):
+        return self.best
+
+    def get_latest_model(self):
+        return self.latest
+
+
+class LocalFileModelSaver:
+    """Checkpoint best/latest to <dir>/bestModel.zip, latestModel.zip
+    (reference LocalFileModelSaver.java; format = ModelSerializer ZIP of
+    configuration.json + coefficients + updater state)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    @property
+    def best_path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    @property
+    def latest_path(self) -> str:
+        return os.path.join(self.directory, "latestModel.zip")
+
+    def save_best_model(self, net, score: float) -> None:
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        ModelSerializer.write_model(net, self.best_path)
+
+    def save_latest_model(self, net, score: float) -> None:
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        ModelSerializer.write_model(net, self.latest_path)
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        if not os.path.exists(self.best_path):
+            return None
+        return ModelSerializer.restore(self.best_path)
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        if not os.path.exists(self.latest_path):
+            return None
+        return ModelSerializer.restore(self.latest_path)

@@ -198,6 +198,13 @@ class Builder:
     def list(self) -> "ListBuilder":
         return ListBuilder(self)
 
+    def graph_builder(self):
+        """Transition to the DAG builder (reference
+        ComputationGraphConfiguration.GraphBuilder :569-605)."""
+        from deeplearning4j_tpu.nn.conf.graph import GraphBuilder
+
+        return GraphBuilder(self)
+
     def global_conf(self) -> Dict[str, Any]:
         g = dict(GLOBAL_DEFAULTS)
         g.update(self._global)

@@ -317,6 +317,10 @@ class MultiLayerNetwork:
         labels = jnp.asarray(labels)
         if self.conf.backprop_type == "truncated_bptt" and features.ndim == 3:
             return self._fit_tbptt(features, labels, mask, label_mask)
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            from deeplearning4j_tpu.optimize.solvers import Solver
+
+            return Solver(self).optimize(features, labels, mask, label_mask)
         step = self._get_train_step(mask is not None, label_mask is not None)
         loss = None
         for _ in range(max(1, self.conf.iterations)):

@@ -1,0 +1,389 @@
+"""Full-batch optimizers: line search, conjugate gradient, LBFGS + Solver.
+
+Mirrors the reference's ``optimize`` package (SURVEY.md section 2.1):
+  - Solver (build + run optimizer — optimize/Solver.java:41-55)
+  - BaseOptimizer (gradientAndScore :150-157; generic line-search loop
+    :165-228; updateGradientAccordingToParams :276)
+  - StochasticGradientDescent.optimize (solvers/StochasticGradientDescent.java:53-74)
+  - ConjugateGradient (91 LoC), LBFGS (163 LoC), LineGradientDescent (65 LoC),
+    BackTrackLineSearch (354 LoC)
+  - step functions (optimize/stepfunctions/) and termination conditions
+    (optimize/terminations/: EpsTermination, Norm2Termination,
+    ZeroDirection)
+
+TPU-first design: the reference's optimizers mutate a flat parameter view
+array; here they are pure functions over a flat jnp vector obtained with
+``ravel_pytree``. The loss/gradient oracle is jitted ONCE and reused across
+iterations, so each CG/LBFGS step is a single compiled XLA call; the outer
+iteration stays in Python (few iterations, host-side control flow — the
+line-search trip counts are data-dependent, which jit cannot trace).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import flatten_util
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# step functions (reference optimize/stepfunctions/)
+# ---------------------------------------------------------------------------
+
+
+def negative_gradient_step(params: Array, direction: Array, step: float) -> Array:
+    """params + step * direction where direction is already a descent
+    direction (reference NegativeGradientStepFunction semantics are folded
+    into direction sign conventions here)."""
+    return params + step * direction
+
+
+# ---------------------------------------------------------------------------
+# termination conditions (reference optimize/terminations/)
+# ---------------------------------------------------------------------------
+
+
+class EpsTermination:
+    """|new - old| < eps * |old| + tolerance (reference EpsTermination.java)."""
+
+    def __init__(self, eps: float = 1e-10, tolerance: float = 1e-6):
+        self.eps = eps
+        self.tolerance = tolerance
+
+    def terminate(self, new_score: float, old_score: float, direction=None) -> bool:
+        return abs(new_score - old_score) <= self.eps * abs(old_score) + self.tolerance
+
+
+class Norm2Termination:
+    """Gradient L2 norm below threshold (reference Norm2Termination.java)."""
+
+    def __init__(self, gradient_norm_threshold: float = 1e-8):
+        self.threshold = gradient_norm_threshold
+
+    def terminate(self, new_score, old_score, direction=None) -> bool:
+        if direction is None:
+            return False
+        return float(jnp.linalg.norm(direction)) < self.threshold
+
+
+class ZeroDirection:
+    """Terminate when the search direction vanishes (reference ZeroDirection.java)."""
+
+    def terminate(self, new_score, old_score, direction=None) -> bool:
+        if direction is None:
+            return False
+        return float(jnp.max(jnp.abs(direction))) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# backtracking line search (reference BackTrackLineSearch.java, 354 LoC)
+# ---------------------------------------------------------------------------
+
+
+def backtrack_line_search(
+    value_fn: Callable[[Array], Array],
+    x: Array,
+    score0: float,
+    grad0: Array,
+    direction: Array,
+    *,
+    initial_step: float = 1.0,
+    max_iterations: int = 5,
+    min_step: float = 1e-12,
+    relative_tolerance: float = 1e-4,
+    wolfe_c1: float = 1e-4,
+) -> Tuple[float, float]:
+    """Armijo backtracking: shrink step until sufficient decrease.
+    Returns (step, new_score). step=0 means no improving step found.
+
+    Reference semantics (BackTrackLineSearch.optimize): start from
+    `initial_step`, halve (they use polynomial interpolation; halving keeps
+    the same contract) until f(x + step*d) <= f(x) + c1*step*g.d.
+    """
+    gd = float(jnp.vdot(grad0, direction))
+    if gd >= 0:
+        # not a descent direction — mirror reference behavior: bail out
+        return 0.0, score0
+    step = float(initial_step)
+    for _ in range(max_iterations):
+        new_score = float(value_fn(x + step * direction))
+        if new_score <= score0 + wolfe_c1 * step * gd and jnp.isfinite(new_score):
+            return step, new_score
+        step *= 0.5
+        if step < min_step:
+            break
+    return 0.0, score0
+
+
+# ---------------------------------------------------------------------------
+# optimizers over a flat vector oracle
+# ---------------------------------------------------------------------------
+
+
+def _value_oracle(vg_fn):
+    """Value-only oracle for line-search probes: use vg_fn.value_only when the
+    caller provides one (Solver does — skips the unused gradient), else fall
+    back to discarding the gradient."""
+    v = getattr(vg_fn, "value_only", None)
+    return v if v is not None else (lambda p: vg_fn(p)[0])
+
+
+class OptimResult(NamedTuple):
+    params: Array
+    score: float
+    iterations: int
+    converged: bool
+
+
+def line_gradient_descent(
+    vg_fn, x0: Array, *, max_iterations: int, line_search_iterations: int = 5,
+    termination: Optional[EpsTermination] = None,
+) -> OptimResult:
+    """Steepest descent with backtracking line search
+    (reference solvers/LineGradientDescent.java)."""
+    termination = termination or EpsTermination()
+    x = x0
+    score, grad = vg_fn(x)
+    score = float(score)
+    it = 0
+    for it in range(1, max_iterations + 1):
+        direction = -grad
+        step, new_score = backtrack_line_search(
+            _value_oracle(vg_fn), x, score, grad, direction,
+            max_iterations=line_search_iterations,
+        )
+        if step == 0.0:
+            return OptimResult(x, score, it, True)
+        x = x + step * direction
+        old = score
+        score, grad = vg_fn(x)
+        score = float(score)
+        if termination.terminate(score, old, grad):
+            return OptimResult(x, score, it, True)
+    return OptimResult(x, score, it, False)
+
+
+def conjugate_gradient(
+    vg_fn, x0: Array, *, max_iterations: int, line_search_iterations: int = 5,
+    termination: Optional[EpsTermination] = None,
+) -> OptimResult:
+    """Nonlinear CG, Polak-Ribiere with automatic restart
+    (reference solvers/ConjugateGradient.java — PR beta, restart on
+    non-descent)."""
+    termination = termination or EpsTermination()
+    x = x0
+    score, grad = vg_fn(x)
+    score = float(score)
+    direction = -grad
+    it = 0
+    for it in range(1, max_iterations + 1):
+        step, _ = backtrack_line_search(
+            _value_oracle(vg_fn), x, score, grad, direction,
+            max_iterations=line_search_iterations,
+        )
+        if step == 0.0:
+            # restart along steepest descent once; if still stuck, converged
+            if bool(jnp.allclose(direction, -grad)):
+                return OptimResult(x, score, it, True)
+            direction = -grad
+            continue
+        x = x + step * direction
+        old_grad = grad
+        old_score = score
+        score, grad = vg_fn(x)
+        score = float(score)
+        # Polak-Ribiere: beta = g_new.(g_new - g_old) / g_old.g_old
+        denom = float(jnp.vdot(old_grad, old_grad))
+        beta = max(0.0, float(jnp.vdot(grad, grad - old_grad)) / max(denom, 1e-30))
+        direction = -grad + beta * direction
+        if termination.terminate(score, old_score, grad):
+            return OptimResult(x, score, it, True)
+    return OptimResult(x, score, it, False)
+
+
+def lbfgs(
+    vg_fn, x0: Array, *, max_iterations: int, memory: int = 10,
+    line_search_iterations: int = 5, termination: Optional[EpsTermination] = None,
+) -> OptimResult:
+    """Limited-memory BFGS with two-loop recursion
+    (reference solvers/LBFGS.java — m=10 history of s/y pairs)."""
+    termination = termination or EpsTermination()
+    x = x0
+    score, grad = vg_fn(x)
+    score = float(score)
+    s_hist: List[Array] = []
+    y_hist: List[Array] = []
+    it = 0
+    for it in range(1, max_iterations + 1):
+        # two-loop recursion
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(s_hist), reversed(y_hist)):
+            ys = float(jnp.vdot(y, s))
+            if abs(ys) < 1e-20:
+                continue  # skip degenerate curvature pair (flat region)
+            rho = 1.0 / ys
+            a = rho * float(jnp.vdot(s, q))
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if y_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            gamma = float(jnp.vdot(s, y)) / max(float(jnp.vdot(y, y)), 1e-30)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(jnp.vdot(y, q))
+            q = q + (a - b) * s
+        direction = -q
+        step, _ = backtrack_line_search(
+            _value_oracle(vg_fn), x, score, grad, direction,
+            max_iterations=line_search_iterations,
+        )
+        if step == 0.0:
+            # fall back to steepest descent before giving up
+            direction = -grad
+            step, _ = backtrack_line_search(
+                _value_oracle(vg_fn), x, score, grad, direction,
+                max_iterations=line_search_iterations,
+            )
+            if step == 0.0:
+                return OptimResult(x, score, it, True)
+            s_hist.clear()
+            y_hist.clear()
+        x_new = x + step * direction
+        old_score = score
+        new_score, new_grad = vg_fn(x_new)
+        new_score = float(new_score)
+        s_hist.append(x_new - x)
+        y_hist.append(new_grad - grad)
+        if len(s_hist) > memory:
+            s_hist.pop(0)
+            y_hist.pop(0)
+        x, score, grad = x_new, new_score, new_grad
+        if termination.terminate(score, old_score, grad):
+            return OptimResult(x, score, it, True)
+    return OptimResult(x, score, it, False)
+
+
+OPTIMIZERS = {
+    "line_gradient_descent": line_gradient_descent,
+    "conjugate_gradient": conjugate_gradient,
+    "lbfgs": lbfgs,
+}
+
+
+# ---------------------------------------------------------------------------
+# Solver — ties an optimizer to a network on one minibatch
+# ---------------------------------------------------------------------------
+
+
+class Solver:
+    """Runs a full-batch optimizer on a network's loss over one minibatch
+    (reference Solver.java:41-55 + BaseOptimizer). SGD is NOT handled here —
+    the containers fuse SGD into their jitted train step; the Solver covers
+    the line-search family (conf.optimization_algo in OPTIMIZERS).
+
+    The value-and-grad and value-only oracles are jitted ONCE per network
+    (cached in the container's _jit_cache) with data as traced arguments, so
+    new minibatches do NOT recompile."""
+
+    def __init__(self, net, algo: Optional[str] = None):
+        self.net = net
+        self.algo = algo or net.conf.optimization_algo
+        if self.algo not in OPTIMIZERS:
+            raise ValueError(
+                f"Solver handles {sorted(OPTIMIZERS)}; got '{self.algo}' "
+                "(stochastic_gradient_descent runs in the container's train step)"
+            )
+
+    # -- oracles (cached across minibatches) --------------------------------
+    def _oracles_mln(self, unravel, has_mask, has_label_mask):
+        net = self.net
+        key = ("solver_vg", has_mask, has_label_mask)
+        if key not in net._jit_cache:
+
+            def loss(p_flat, states, x, y, mask, label_mask):
+                val, _ = net._loss(
+                    unravel(p_flat), states, x, y,
+                    train=False, rng=None, mask=mask, label_mask=label_mask,
+                )
+                return val
+
+            net._jit_cache[key] = (
+                jax.jit(jax.value_and_grad(loss)),
+                jax.jit(loss),
+            )
+        return net._jit_cache[key]
+
+    def _oracles_graph(self, unravel, has_masks, has_label_masks):
+        net = self.net
+        key = ("solver_vg", has_masks, has_label_masks)
+        if key not in net._jit_cache:
+
+            def loss(p_flat, states, inputs, labels, masks, label_masks):
+                val, _ = net._loss(
+                    unravel(p_flat), states, inputs, labels,
+                    train=False, rng=None, masks=masks, label_masks=label_masks,
+                )
+                return val
+
+            net._jit_cache[key] = (
+                jax.jit(jax.value_and_grad(loss)),
+                jax.jit(loss),
+            )
+        return net._jit_cache[key]
+
+    def _run(self, vg_fn, flat0, unravel, max_iterations) -> float:
+        net = self.net
+        opt = OPTIMIZERS[self.algo]
+        res = opt(
+            vg_fn,
+            flat0,
+            max_iterations=max_iterations or max(1, net.conf.iterations),
+            line_search_iterations=net.conf.max_num_line_search_iterations,
+        )
+        net.params = unravel(res.params)
+        net._score_dev = jnp.asarray(res.score)
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration, res.score)
+        net.iteration += res.iterations
+        return res.score
+
+    def optimize(self, features, labels, mask=None, label_mask=None,
+                 max_iterations: Optional[int] = None) -> float:
+        """MultiLayerNetwork path."""
+        net = self.net
+        if net.params is None:
+            net.init()
+        x = jnp.asarray(features)
+        y = jnp.asarray(labels)
+        flat0, unravel = flatten_util.ravel_pytree(net.params)
+        vg, v = self._oracles_mln(unravel, mask is not None, label_mask is not None)
+        vg_bound = lambda f: vg(f, net.states, x, y, mask, label_mask)
+        # optimizers call vg_fn for both value+grad steps and value-only line
+        # search probes; bind the cheap value-only oracle via attribute
+        vg_bound.value_only = lambda f: v(f, net.states, x, y, mask, label_mask)
+        return self._run(vg_bound, flat0, unravel, max_iterations)
+
+    def optimize_graph(self, inputs, labels, masks=None, label_masks=None,
+                       max_iterations: Optional[int] = None) -> float:
+        """ComputationGraph path (inputs: name-keyed dict; labels: list)."""
+        net = self.net
+        if net.params is None:
+            net.init()
+        flat0, unravel = flatten_util.ravel_pytree(net.params)
+        vg, v = self._oracles_graph(
+            unravel, bool(masks), label_masks is not None
+        )
+        masks = masks or {}
+        vg_bound = lambda f: vg(f, net.states, inputs, labels, masks, label_masks)
+        vg_bound.value_only = lambda f: v(
+            f, net.states, inputs, labels, masks, label_masks
+        )
+        return self._run(vg_bound, flat0, unravel, max_iterations)

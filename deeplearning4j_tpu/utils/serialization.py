@@ -57,12 +57,19 @@ def _npz_bytes_into_tree(data: bytes, template):
 class ModelSerializer:
     @staticmethod
     def write_model(net, path: str, save_updater: bool = True) -> None:
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
-
+        is_graph = hasattr(net, "_input_shapes")  # ComputationGraph
+        if is_graph:
+            ishape = (
+                {k: list(v) for k, v in net._input_shapes.items()}
+                if net._input_shapes
+                else None
+            )
+        else:
+            ishape = list(net._input_shape) if net._input_shape else None
         meta: Dict[str, Any] = {
             "format_version": FORMAT_VERSION,
             "iteration": net.iteration,
-            "input_shape": list(net._input_shape) if net._input_shape else None,
+            "input_shape": ishape,
             "model_class": type(net).__name__,
         }
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
@@ -95,3 +102,38 @@ class ModelSerializer:
                 )
             net.iteration = int(meta.get("iteration", 0))
         return net
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        """reference restoreComputationGraph (ModelSerializer.java, graph
+        variant)."""
+        from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        with zipfile.ZipFile(path, "r") as z:
+            conf = ComputationGraphConfiguration.from_json(
+                z.read("configuration.json").decode()
+            )
+            meta = json.loads(z.read("metadata.json").decode())
+            net = ComputationGraph(conf)
+            ishape = meta.get("input_shape")
+            net.init(
+                {k: tuple(v) for k, v in ishape.items()} if ishape else None
+            )
+            net.params = _npz_bytes_into_tree(z.read("coefficients.npz"), net.params)
+            net.states = _npz_bytes_into_tree(z.read("state.npz"), net.states)
+            if load_updater and "updater.npz" in z.namelist():
+                net.updater_state = _npz_bytes_into_tree(
+                    z.read("updater.npz"), net.updater_state
+                )
+            net.iteration = int(meta.get("iteration", 0))
+        return net
+
+    @staticmethod
+    def restore(path: str, load_updater: bool = True):
+        """Restore either container, dispatching on the saved model_class."""
+        with zipfile.ZipFile(path, "r") as z:
+            meta = json.loads(z.read("metadata.json").decode())
+        if meta.get("model_class") == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(path, load_updater)
+        return ModelSerializer.restore_multi_layer_network(path, load_updater)

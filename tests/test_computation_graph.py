@@ -1,0 +1,570 @@
+"""ComputationGraph tests.
+
+Mirrors the reference's graph test strategy (SURVEY.md section 4):
+TestComputationGraphNetwork (build/fit/output/score), JSON round-trip
+(ComputationGraphConfigurationTest), vertex behavior, multi-input/multi-output,
+rnn vertices (ComputationGraphTestRNN), and gradient checking
+(GradientCheckTestsComputationGraph).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertex,
+    ElementWiseVertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    ScaleVertex,
+    SubsetVertex,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def _simple_graph_conf(seed=12345, lr=0.1):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        .add_layer(
+            "out",
+            OutputLayer(n_in=8, n_out=3, activation="softmax", loss_function="mcxent"),
+            "d1",
+        )
+        .set_outputs("out")
+        .build()
+    )
+
+
+def _iris_like(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+class TestBuildAndValidate:
+    def test_topological_order(self):
+        conf = _simple_graph_conf()
+        assert conf.topological_order() == ["d1", "out"]
+
+    def test_cycle_detection(self):
+        conf = ComputationGraphConfiguration(
+            inputs=["in"],
+            vertices={"a": MergeVertex(), "b": MergeVertex()},
+            vertex_inputs={"a": ["b"], "b": ["a"]},
+            outputs=["a"],
+        )
+        with pytest.raises(ValueError, match="cycle"):
+            conf.topological_order()
+
+    def test_unknown_input_rejected(self):
+        with pytest.raises(ValueError, match="unknown input"):
+            (
+                NeuralNetConfiguration.builder()
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=2, n_out=2), "nope")
+                .set_outputs("d")
+                .build()
+            )
+
+    def test_duplicate_name_rejected(self):
+        gb = (
+            NeuralNetConfiguration.builder()
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=2, n_out=2), "in")
+        )
+        with pytest.raises(ValueError, match="duplicate"):
+            gb.add_layer("d", DenseLayer(n_in=2, n_out=2), "in")
+
+
+class TestJsonRoundTrip:
+    def test_simple(self):
+        conf = _simple_graph_conf()
+        j = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(j)
+        assert conf2.to_json() == j
+        assert conf2.topological_order() == conf.topological_order()
+
+    def test_vertices_survive(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("d1", DenseLayer(n_in=4, n_out=4), "a")
+            .add_vertex("ew", ElementWiseVertex(op="product"), "d1", "b")
+            .add_vertex("sub", SubsetVertex(from_index=0, to_index=1), "ew")
+            .add_vertex("sc", ScaleVertex(scale=0.5), "sub")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=2, n_out=2, activation="softmax", loss_function="mcxent"),
+                "sc",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert isinstance(conf2.vertices["ew"], ElementWiseVertex)
+        assert conf2.vertices["ew"].op == "product"
+        assert conf2.vertices["sub"].to_index == 1
+        assert conf2.vertices["sc"].scale == 0.5
+
+
+class TestFitAndOutput:
+    def test_fit_reduces_score(self):
+        conf = _simple_graph_conf()
+        net = ComputationGraph(conf).init()
+        x, y = _iris_like(64)
+        first = float(net.fit(x, y))
+        for _ in range(30):
+            last = float(net.fit(x, y))
+        assert last < first
+
+    def test_output_shape_and_softmax(self):
+        net = ComputationGraph(_simple_graph_conf()).init()
+        x, _ = _iris_like(8)
+        (out,) = net.output(x)
+        assert out.shape == (8, 3)
+        np.testing.assert_allclose(np.sum(np.asarray(out), axis=1), 1.0, atol=1e-5)
+
+    def test_equivalent_to_multilayer(self):
+        """A linear graph must match the sequential container exactly
+        (same seed, same layers) — the graph generalizes, not diverges."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        mln_conf = (
+            NeuralNetConfiguration.builder()
+            .seed(777)
+            .learning_rate(0.1)
+            .list()
+            .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(
+                1,
+                OutputLayer(
+                    n_in=8, n_out=3, activation="softmax", loss_function="mcxent"
+                ),
+            )
+            .build()
+        )
+        mln = MultiLayerNetwork(mln_conf).init()
+        cg = ComputationGraph(_simple_graph_conf(seed=777)).init()
+        x, y = _iris_like(16)
+        l_m = float(mln.fit(x, y))
+        l_g = float(cg.fit(x, y))
+        # same loss function and data; init RNG streams differ by layer
+        # keying so allow loose agreement on the first loss magnitude
+        assert abs(l_m - l_g) < 1.0
+        for _ in range(10):
+            l_m = float(mln.fit(x, y))
+            l_g = float(cg.fit(x, y))
+        assert l_g < 1.2  # both learn
+
+
+class TestVertices:
+    def test_merge_concatenates(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="relu"), "a")
+            .add_layer("db", DenseLayer(n_in=5, n_out=6, activation="relu"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=10, n_out=2, activation="softmax", loss_function="mcxent"),
+                "m",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(4, 5)).astype(np.float32)
+        acts = net.feed_forward(a, b)
+        assert acts["m"].shape == (4, 10)
+        np.testing.assert_allclose(
+            np.asarray(acts["m"]),
+            np.concatenate([np.asarray(acts["da"]), np.asarray(acts["db"])], axis=1),
+            rtol=1e-6,
+        )
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        net.fit([a, b], y)  # trains without error
+
+    def test_elementwise_add_and_subset(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1)
+            .graph_builder()
+            .add_inputs("x")
+            .add_layer("d1", DenseLayer(n_in=4, n_out=4, activation="identity"), "x")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "d1", "x")
+            .add_vertex("first2", SubsetVertex(from_index=0, to_index=1), "sum")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=2, n_out=2, activation="softmax", loss_function="mcxent"),
+                "first2",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+        acts = net.feed_forward(x)
+        np.testing.assert_allclose(
+            np.asarray(acts["sum"]), np.asarray(acts["d1"]) + x, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(acts["first2"]), np.asarray(acts["sum"])[:, :2], rtol=1e-6
+        )
+
+    def test_residual_block_trains(self):
+        """ElementWiseVertex add = the residual-connection pattern."""
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(3)
+            .learning_rate(0.05)
+            .updater("adam")
+            .graph_builder()
+            .add_inputs("x")
+            .add_layer("d1", DenseLayer(n_in=8, n_out=8, activation="relu"), "x")
+            .add_layer("d2", DenseLayer(n_in=8, n_out=8, activation="identity"), "d1")
+            .add_vertex("res", ElementWiseVertex(op="add"), "d2", "x")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=8, n_out=2, activation="softmax", loss_function="mcxent"),
+                "res",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(axis=1) > 0).astype(int)]
+        first = float(net.fit(x, y))
+        for _ in range(40):
+            last = float(net.fit(x, y))
+        assert last < first
+
+
+class TestMultiOutput:
+    def test_two_outputs_sum_losses(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(9)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("x")
+            .add_layer("trunk", DenseLayer(n_in=4, n_out=8, activation="tanh"), "x")
+            .add_layer(
+                "out1",
+                OutputLayer(n_in=8, n_out=3, activation="softmax", loss_function="mcxent"),
+                "trunk",
+            )
+            .add_layer(
+                "out2",
+                OutputLayer(n_in=8, n_out=2, activation="softmax", loss_function="mcxent"),
+                "trunk",
+            )
+            .set_outputs("out1", "out2")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        y2 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        first = float(net.fit(x, [y1, y2]))
+        for _ in range(20):
+            last = float(net.fit(x, [y1, y2]))
+        assert last < first
+        o1, o2 = net.output(x)
+        assert o1.shape == (16, 3) and o2.shape == (16, 2)
+        # score == sum of the two losses (computeGradientAndScore :894-907)
+        s = net.score(x, [y1, y2])
+        assert s > 0
+
+
+class TestRnnVertices:
+    def test_last_time_step_vertex(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(4)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=5, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "lstm")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=5, n_out=2, activation="softmax", loss_function="mcxent"),
+                "last",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init(input_shapes={"seq": (-1, 3)})
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(6, 7, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+        acts = net.feed_forward(x)
+        assert acts["last"].shape == (6, 5)
+        np.testing.assert_allclose(
+            np.asarray(acts["last"]), np.asarray(acts["lstm"])[:, -1, :], rtol=1e-6
+        )
+        net.fit(x, y)
+
+    def test_last_time_step_vertex_masked(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(4)
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=5, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(mask_input="seq"), "lstm")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=5, n_out=2, activation="softmax", loss_function="mcxent"),
+                "last",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init(input_shapes={"seq": (-1, 3)})
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+        mask = np.array(
+            [[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], dtype=np.float32
+        )
+        inputs = {"seq": np.asarray(x)}
+        acts, _ = net._forward(
+            net.params,
+            net.states,
+            {k: v for k, v in inputs.items()},
+            train=False,
+            masks={"seq": mask},
+        )
+        # row 0: last unmasked step is index 2
+        np.testing.assert_allclose(
+            np.asarray(acts["last"])[0], np.asarray(acts["lstm"])[0, 2, :], rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(acts["last"])[1], np.asarray(acts["lstm"])[1, 4, :], rtol=1e-6
+        )
+
+    def test_duplicate_to_time_series_seq2seq(self):
+        """Encoder LastTimeStep -> DuplicateToTimeSeries decoder-conditioning
+        (the reference's seq2seq vertex pair)."""
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(6)
+            .learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("enc", GravesLSTM(n_in=2, n_out=4, activation="tanh"), "seq")
+            .add_vertex("last", LastTimeStepVertex(), "enc")
+            .add_vertex("dup", DuplicateToTimeSeriesVertex(reference_input="seq"), "last")
+            .add_layer("dec", GravesLSTM(n_in=4, n_out=4, activation="tanh"), "dup")
+            .add_layer(
+                "out",
+                RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss_function="mcxent"),
+                "dec",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init(input_shapes={"seq": (-1, 2)})
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, 6, 2)).astype(np.float32)
+        y = np.tile(
+            np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)][:, None, :], (1, 6, 1)
+        )
+        acts = net.feed_forward(x)
+        assert acts["dup"].shape == (3, 6, 4)
+        # every timestep of dup equals the encoder's last step
+        np.testing.assert_allclose(
+            np.asarray(acts["dup"])[:, 0, :], np.asarray(acts["last"]), rtol=1e-6
+        )
+        first = float(net.fit(x, y))
+        for _ in range(10):
+            last = float(net.fit(x, y))
+        assert last < first
+
+    def test_rnn_time_step(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(4)
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=4, activation="tanh"), "seq")
+            .add_layer(
+                "out",
+                RnnOutputLayer(n_in=4, n_out=3, activation="softmax", loss_function="mcxent"),
+                "lstm",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init(input_shapes={"seq": (-1, 3)})
+        rng = np.random.default_rng(0)
+        seq = rng.normal(size=(2, 4, 3)).astype(np.float32)
+        # full-sequence output
+        (full,) = net.output(seq)
+        # step-by-step must match (stateful streaming, rnnTimeStep :1601)
+        net.rnn_clear_previous_state()
+        outs = []
+        for t in range(4):
+            (o,) = net.rnn_time_step(seq[:, t, :])
+            outs.append(np.asarray(o))
+        np.testing.assert_allclose(
+            np.stack(outs, axis=1), np.asarray(full), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestGraphGradients:
+    def test_gradient_check_merge_graph(self):
+        """Central-difference check through Merge + ElementWise vertices
+        (GradientCheckTestsComputationGraph equivalent)."""
+        from deeplearning4j_tpu.utils.gradient_check import check_graph_gradients
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(11)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_in=3, n_out=4, activation="sigmoid"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=8, n_out=2, activation="softmax", loss_function="mcxent"),
+                "m",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 3))
+        b = rng.normal(size=(4, 3))
+        y = np.eye(2)[rng.integers(0, 2, 4)]
+        ok, max_rel = check_graph_gradients(
+            net, [a, b], [y], max_params_per_leaf=10
+        )
+        assert ok, f"max relative error {max_rel}"
+
+
+class TestGraphPersistence:
+    def test_model_serializer_roundtrip(self, tmp_path):
+        """ModelSerializer handles graphs (reference restoreComputationGraph)."""
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        net = ComputationGraph(_simple_graph_conf()).init()
+        x, y = _iris_like(16)
+        net.fit(x, y)
+        p = str(tmp_path / "graph.zip")
+        ModelSerializer.write_model(net, p)
+        restored = ModelSerializer.restore(p)
+        assert isinstance(restored, ComputationGraph)
+        (o1,) = net.output(x)
+        (o2,) = restored.output(x)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-6)
+        assert restored.iteration == net.iteration
+
+    def test_clone_preserves_iteration(self):
+        net = ComputationGraph(_simple_graph_conf()).init()
+        x, y = _iris_like(8)
+        net.fit(x, y)
+        net.fit(x, y)
+        c = net.clone()
+        assert c.iteration == net.iteration
+
+
+class TestGraphSolver:
+    def test_lbfgs_graph_training(self):
+        """conf.optimization_algo is honored by the graph container too."""
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(5)
+            .optimization_algo("lbfgs")
+            .iterations(25)
+            .max_num_line_search_iterations(10)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer(
+                "out",
+                OutputLayer(n_in=8, n_out=3, activation="softmax", loss_function="mcxent"),
+                "d1",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        x, y = _iris_like(32)
+        before = net.score(x, y)
+        net.fit(x, y)
+        after = net.score(x, y)
+        assert after < before * 0.7
+
+
+class TestGraphMasking:
+    def test_feature_mask_reaches_rnn_output_loss(self):
+        """Feature mask must mask the RnnOutputLayer loss when no label mask
+        is given (MLN parity: lmask falls back to the feature mask)."""
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(8)
+            .graph_builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_in=2, n_out=4, activation="tanh"), "seq")
+            .add_layer(
+                "out",
+                RnnOutputLayer(n_in=4, n_out=2, activation="softmax", loss_function="mcxent"),
+                "lstm",
+            )
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init(input_shapes={"seq": (-1, 2)})
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 6, 2)).astype(np.float32)
+        y = np.tile(np.array([[1.0, 0.0]], np.float32), (2, 6, 1)).astype(np.float32)
+        full = net.score(x, y)
+        mask = np.ones((2, 6), np.float32)
+        mask[:, 3:] = 0.0
+        # corrupt the masked-out region of the labels; score must not change
+        y2 = y.copy()
+        y2[:, 3:, :] = np.array([0.0, 1.0], np.float32)
+        import jax.numpy as jnp
+
+        s_masked_clean, _ = net._loss(
+            net.params, net.states,
+            {"seq": jnp.asarray(x)}, [jnp.asarray(y)],
+            train=False, rng=None, masks={"seq": jnp.asarray(mask)},
+        )
+        s_masked_corrupt, _ = net._loss(
+            net.params, net.states,
+            {"seq": jnp.asarray(x)}, [jnp.asarray(y2)],
+            train=False, rng=None, masks={"seq": jnp.asarray(mask)},
+        )
+        np.testing.assert_allclose(
+            float(s_masked_clean), float(s_masked_corrupt), rtol=1e-6
+        )
+        assert abs(float(s_masked_clean) - float(full)) > 1e-9

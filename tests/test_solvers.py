@@ -1,0 +1,193 @@
+"""Optimizer tests on analytic toy objectives.
+
+Mirrors the reference's TestOptimizers
+(deeplearning4j-core/src/test/java/org/deeplearning4j/optimize/solver/
+TestOptimizers.java:141-302): Sphere / Rastrigin / Rosenbrock functions per
+algorithm per dimension, plus BackTrackLineSearchTest and a Solver-on-network
+integration test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.solvers import (
+    EpsTermination,
+    Norm2Termination,
+    OPTIMIZERS,
+    Solver,
+    backtrack_line_search,
+    conjugate_gradient,
+    lbfgs,
+    line_gradient_descent,
+)
+
+
+def sphere_vg():
+    @jax.jit
+    def vg(x):
+        def f(x_):
+            return jnp.sum(x_ * x_)
+
+        return jax.value_and_grad(f)(x)
+
+    return vg
+
+
+def rosenbrock_vg():
+    @jax.jit
+    def vg(x):
+        def f(x_):
+            return jnp.sum(
+                100.0 * (x_[1:] - x_[:-1] ** 2) ** 2 + (1.0 - x_[:-1]) ** 2
+            )
+
+        return jax.value_and_grad(f)(x)
+
+    return vg
+
+
+def rastrigin_vg():
+    @jax.jit
+    def vg(x):
+        def f(x_):
+            return 10.0 * x_.size + jnp.sum(
+                x_ * x_ - 10.0 * jnp.cos(2.0 * jnp.pi * x_)
+            )
+
+        return jax.value_and_grad(f)(x)
+
+    return vg
+
+
+@pytest.mark.parametrize("dim", [2, 10, 100])
+@pytest.mark.parametrize("opt_name", sorted(OPTIMIZERS))
+def test_sphere_converges(opt_name, dim):
+    """Sphere: every algorithm must reach near-zero from a random start
+    (reference testSphereFnOptimization variants)."""
+    rng = np.random.default_rng(dim)
+    x0 = jnp.asarray(rng.uniform(-4, 4, dim))
+    res = OPTIMIZERS[opt_name](
+        sphere_vg(), x0, max_iterations=200, line_search_iterations=20
+    )
+    assert res.score < 1e-2, f"{opt_name} dim={dim}: {res.score}"
+
+
+@pytest.mark.parametrize("opt_name", ["conjugate_gradient", "lbfgs"])
+def test_rosenbrock_improves(opt_name):
+    """Rosenbrock valley: second-order-ish methods must make strong progress
+    (reference testRosenbrockFnOptimization — asserts score decreases)."""
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.uniform(-2, 2, 10))
+    vg = rosenbrock_vg()
+    first = float(vg(x0)[0])
+    res = OPTIMIZERS[opt_name](vg, x0, max_iterations=300, line_search_iterations=30)
+    assert res.score < first * 1e-2, f"{opt_name}: {first} -> {res.score}"
+
+
+@pytest.mark.parametrize("opt_name", sorted(OPTIMIZERS))
+def test_rastrigin_decreases(opt_name):
+    """Rastrigin is multimodal — require decrease, not global optimum
+    (reference uses the same weak assertion)."""
+    rng = np.random.default_rng(1)
+    x0 = jnp.asarray(rng.uniform(-4, 4, 10))
+    vg = rastrigin_vg()
+    first = float(vg(x0)[0])
+    res = OPTIMIZERS[opt_name](vg, x0, max_iterations=100, line_search_iterations=20)
+    assert res.score < first
+
+
+class TestBackTrackLineSearch:
+    def test_finds_decreasing_step(self):
+        vg = sphere_vg()
+        x = jnp.asarray([3.0, 4.0])
+        score, grad = vg(x)
+        step, new_score = backtrack_line_search(
+            lambda p: vg(p)[0], x, float(score), grad, -grad, max_iterations=10
+        )
+        assert step > 0
+        assert new_score < float(score)
+
+    def test_rejects_ascent_direction(self):
+        vg = sphere_vg()
+        x = jnp.asarray([3.0, 4.0])
+        score, grad = vg(x)
+        step, new_score = backtrack_line_search(
+            lambda p: vg(p)[0], x, float(score), grad, grad, max_iterations=10
+        )
+        assert step == 0.0
+        assert new_score == float(score)
+
+
+class TestTerminations:
+    def test_eps_termination(self):
+        t = EpsTermination(eps=1e-3, tolerance=0.0)
+        assert t.terminate(100.0, 100.05)
+        assert not t.terminate(100.0, 150.0)
+
+    def test_norm2_termination(self):
+        t = Norm2Termination(gradient_norm_threshold=1e-3)
+        assert t.terminate(0, 0, jnp.asarray([1e-5, 1e-5]))
+        assert not t.terminate(0, 0, jnp.asarray([1.0, 1.0]))
+
+
+class TestSolverOnNetwork:
+    @pytest.mark.parametrize("algo", ["conjugate_gradient", "lbfgs"])
+    def test_network_trains_with_line_search_family(self, algo):
+        """Full-batch CG/LBFGS training of a tiny MLP (reference
+        MultiLayerTest with OptimizationAlgorithm.CONJUGATE_GRADIENT/LBFGS)."""
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer,
+            NeuralNetConfiguration,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(42)
+            .optimization_algo(algo)
+            .iterations(30)
+            .max_num_line_search_iterations(10)
+            .list()
+            .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(
+                1,
+                OutputLayer(
+                    n_in=8, n_out=3, activation="softmax", loss_function="mcxent"
+                ),
+            )
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        first = float(net.score(x, y))
+        net.fit(x, y)
+        last = float(net.score(x, y))
+        assert last < first * 0.7, f"{algo}: {first} -> {last}"
+
+    def test_solver_rejects_sgd(self):
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer,
+            NeuralNetConfiguration,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(0, DenseLayer(n_in=2, n_out=2))
+            .layer(
+                1,
+                OutputLayer(
+                    n_in=2, n_out=2, activation="softmax", loss_function="mcxent"
+                ),
+            )
+            .build()
+        )
+        with pytest.raises(ValueError, match="stochastic_gradient_descent"):
+            Solver(MultiLayerNetwork(conf).init(), algo="stochastic_gradient_descent")
