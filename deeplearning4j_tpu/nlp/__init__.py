@@ -1,0 +1,64 @@
+"""NLP stack — capability surface of deeplearning4j-nlp (SURVEY.md section 2.4).
+
+Text infrastructure (tokenizers, sentence/document iterators, stopwords),
+vocabulary construction, Huffman coding, embedding lookup tables, and the
+embedding model family (Word2Vec skip-gram/CBOW, GloVe, ParagraphVectors,
+bag-of-words / TF-IDF vectorizers).
+
+TPU-native design: the reference trains embeddings with Hogwild threads
+mutating shared syn0/syn1 matrices
+(deeplearning4j-nlp/.../models/sequencevectors/SequenceVectors.java:137-210).
+Here training is BATCHED and deterministic: the host assembles minibatches of
+(center, context, huffman-path / negative-sample) indices; one jitted XLA
+program does gathers, sigmoid math, and scatter-adds on the embedding
+matrices (`.at[].add()` lowers to a single fused scatter on TPU).
+"""
+
+from deeplearning4j_tpu.nlp.text import (
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LineSentenceIterator,
+    AggregatingSentenceIterator,
+    BasicLabelAwareIterator,
+    STOP_WORDS,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabWord, VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.huffman import build_huffman
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.vectorizers import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.serializer import (
+    write_word_vectors,
+    read_word_vectors,
+    save_word2vec,
+    load_word2vec,
+)
+
+__all__ = [
+    "DefaultTokenizerFactory",
+    "NGramTokenizerFactory",
+    "CollectionSentenceIterator",
+    "FileSentenceIterator",
+    "LineSentenceIterator",
+    "AggregatingSentenceIterator",
+    "BasicLabelAwareIterator",
+    "STOP_WORDS",
+    "VocabWord",
+    "VocabCache",
+    "VocabConstructor",
+    "build_huffman",
+    "InMemoryLookupTable",
+    "Word2Vec",
+    "ParagraphVectors",
+    "Glove",
+    "BagOfWordsVectorizer",
+    "TfidfVectorizer",
+    "write_word_vectors",
+    "read_word_vectors",
+    "save_word2vec",
+    "load_word2vec",
+]

@@ -1,0 +1,201 @@
+"""GloVe: co-occurrence counting + AdaGrad weighted least-squares regression.
+
+Capability mirror of the reference
+(deeplearning4j-nlp/.../models/glove/Glove.java:32 driver;
+models/glove/AbstractCoOccurrences.java — windowed, distance-weighted
+co-occurrence counting; models/glove/GloveWeightLookupTable.java — the
+per-pair AdaGrad update: error = wi·wj + bi + bj - log(X_ij), weighted by
+fdiff = min(1, (X_ij/xMax)^alpha)).
+
+TPU-native redesign: the reference iterates pairs one at a time updating
+shared matrices. Here all co-occurrence triples (i, j, X_ij) are assembled
+once on host, then minibatches run through a jitted step doing batched
+gathers, the weighted-squared-error gradient, AdaGrad state updates, and
+scatter-adds — same math, one XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory, common_preprocessor
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _glove_step(W, b, hW, hb, wi, wj, logx, fdiff, lr, live):
+    """Batched AdaGrad GloVe update on symmetric factor matrices.
+
+    W: (V, D) vectors (the reference trains main+context the same way via
+    symmetric pair iteration; wi/wj index the same matrix), b: (V,) biases,
+    hW/hb: AdaGrad accumulators.
+    """
+    vi, vj = W[wi], W[wj]  # (B, D)
+    pred = jnp.einsum("bd,bd->b", vi, vj) + b[wi] + b[wj]
+    diff = (pred - logx) * live
+    wdiff = fdiff * diff  # (B,)
+
+    gi = wdiff[:, None] * vj  # dL/dvi
+    gj = wdiff[:, None] * vi
+    gbi = wdiff
+    gbj = wdiff
+
+    # AdaGrad: accumulate squared grads, scale lr by 1/sqrt(h)
+    hW = hW.at[wi].add(gi * gi)
+    hW = hW.at[wj].add(gj * gj)
+    hb = hb.at[wi].add(gbi * gbi)
+    hb = hb.at[wj].add(gbj * gbj)
+    eps = 1e-8
+    W = W.at[wi].add(-lr * gi / (jnp.sqrt(hW[wi]) + eps))
+    W = W.at[wj].add(-lr * gj / (jnp.sqrt(hW[wj]) + eps))
+    b = b.at[wi].add(-lr * gbi / (jnp.sqrt(hb[wi]) + eps))
+    b = b.at[wj].add(-lr * gbj / (jnp.sqrt(hb[wj]) + eps))
+    loss = 0.5 * jnp.sum(fdiff * diff * diff)
+    return W, b, hW, hb, loss
+
+
+class Glove:
+    """Reference Glove builder surface: layerSize, learningRate, xMax, alpha,
+    epochs, minWordFrequency, window (Glove.java builder)."""
+
+    def __init__(
+        self,
+        layer_size: int = 100,
+        learning_rate: float = 0.05,
+        x_max: float = 100.0,
+        alpha: float = 0.75,
+        epochs: int = 5,
+        min_word_frequency: int = 1,
+        window: int = 15,
+        symmetric: bool = True,
+        seed: int = 123,
+        batch_size: int = 4096,
+        tokenizer: Optional[DefaultTokenizerFactory] = None,
+    ):
+        self.layer_size = layer_size
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.epochs = epochs
+        self.min_word_frequency = min_word_frequency
+        self.window = window
+        self.symmetric = symmetric
+        self.seed = seed
+        self.batch_size = batch_size
+        self.tokenizer = tokenizer or DefaultTokenizerFactory(common_preprocessor)
+        self.vocab: Optional[VocabCache] = None
+        self.W: Optional[np.ndarray] = None
+        self.bias: Optional[np.ndarray] = None
+        self.losses: List[float] = []
+
+    # -- co-occurrences ---------------------------------------------------
+    def _count_cooccurrences(self, seqs: List[np.ndarray]) -> Dict[Tuple[int, int], float]:
+        """Distance-weighted windowed counts (AbstractCoOccurrences: weight
+        1/distance, symmetric window)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        w = self.window
+        for seq in seqs:
+            n = len(seq)
+            for i in range(n):
+                for d in range(1, w + 1):
+                    j = i + d
+                    if j >= n:
+                        break
+                    a, bb = int(seq[i]), int(seq[j])
+                    if a == bb:
+                        continue
+                    key = (min(a, bb), max(a, bb)) if self.symmetric else (a, bb)
+                    counts[key] = counts.get(key, 0.0) + 1.0 / d
+        return counts
+
+    # -- training ---------------------------------------------------------
+    def fit(self, sentences: Iterable[str]) -> "Glove":
+        token_seqs = []
+        for s in sentences:
+            toks = self.tokenizer.tokenize(s)
+            if toks:
+                token_seqs.append(toks)
+        self.vocab = VocabConstructor(
+            self.min_word_frequency, build_huffman_tree=False
+        ).build(token_seqs)
+        vocab = self.vocab
+        seqs = []
+        for toks in token_seqs:
+            idx = np.array(
+                [vocab.index_of(t) for t in toks if vocab.index_of(t) >= 0], np.int32
+            )
+            if idx.size:
+                seqs.append(idx)
+
+        counts = self._count_cooccurrences(seqs)
+        if not counts:
+            raise ValueError("empty co-occurrence matrix — corpus too small")
+        pairs = np.array(list(counts.keys()), np.int32)
+        xs = np.array(list(counts.values()), np.float64)
+        logx = np.log(xs).astype(np.float32)
+        fdiff = np.minimum(1.0, (xs / self.x_max) ** self.alpha).astype(np.float32)
+
+        V, D = vocab.num_words(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        W = jnp.asarray(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
+        b = jnp.zeros((V,), jnp.float32)
+        hW = jnp.full((V, D), 1e-8, jnp.float32)
+        hb = jnp.full((V,), 1e-8, jnp.float32)
+
+        B = self.batch_size
+        n = len(pairs)
+        for _epoch in range(self.epochs):
+            order = rng.permutation(n)
+            epoch_loss = 0.0
+            for bi in range(-(-n // B)):
+                sel = order[bi * B : (bi + 1) * B]
+                m = len(sel)
+                if m < B:  # pad to static shape
+                    sel = np.concatenate([sel, np.repeat(sel[:1], B - m)])
+                live = (np.arange(B) < m).astype(np.float32)
+                W, b, hW, hb, loss = _glove_step(
+                    W, b, hW, hb,
+                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
+                    jnp.asarray(logx[sel]), jnp.asarray(fdiff[sel]),
+                    jnp.float32(self.learning_rate), jnp.asarray(live),
+                )
+                epoch_loss += float(loss)
+            self.losses.append(epoch_loss / n)
+
+        self.W = np.asarray(W)
+        self.bias = np.asarray(b)
+        return self
+
+    # -- query ------------------------------------------------------------
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.vocab.index_of(word) if self.vocab else -1
+        return None if idx < 0 else self.W[idx]
+
+    def similarity(self, w1: str, w2: str) -> float:
+        v1, v2 = self.vector(w1), self.vector(w2)
+        if v1 is None or v2 is None:
+            return float("nan")
+        denom = float(np.linalg.norm(v1) * np.linalg.norm(v2)) or 1.0
+        return float(np.dot(v1, v2) / denom)
+
+    def words_nearest(self, word: str, top_n: int = 10) -> List[str]:
+        v = self.vector(word)
+        if v is None:
+            return []
+        norms = np.linalg.norm(self.W, axis=1)
+        norms = np.where(norms == 0, 1.0, norms)
+        sims = self.W @ v / (norms * (np.linalg.norm(v) or 1.0))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            cand = self.vocab.word_at_index(int(i))
+            if cand != word:
+                out.append(cand)
+            if len(out) >= top_n:
+                break
+        return out

@@ -1,0 +1,75 @@
+"""Huffman tree builder for hierarchical softmax.
+
+Behavioral mirror of the reference's word2vec.c-style two-pointer Huffman
+construction (deeplearning4j-nlp/.../models/word2vec/Huffman.java:34-66,
+build() at :66): words sorted by descending frequency; two sorted frontiers
+(original leaves walked backward, new internal nodes appended forward) are
+merged by repeatedly combining the two smallest counts; each leaf then reads
+its code (binary branch bits, leaf-to-root reversed) and points (internal
+node ids along the path, root-first), with MAX_CODE_LENGTH capping depth.
+
+Implemented from the algorithm's definition — O(V) after the sort, no heap.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+MAX_CODE_LENGTH = 40
+
+
+def build_huffman(words: Sequence, max_code_length: int = MAX_CODE_LENGTH) -> None:
+    """Assign `codes` and `points` to each VocabWord in `words`.
+
+    `words` must already be sorted by descending frequency with index i ==
+    position i (VocabCache.finalize_vocab guarantees this). Mutates the
+    VocabWord objects in place, like the reference's Huffman.applyIndexes.
+    """
+    n = len(words)
+    if n == 0:
+        return
+    if n == 1:
+        words[0].codes = [0]
+        words[0].points = [0]
+        return
+
+    count = [0] * (2 * n + 1)
+    binary = [0] * (2 * n + 1)
+    parent = [0] * (2 * n + 1)
+    for i, w in enumerate(words):
+        count[i] = int(w.count)
+    for i in range(n, 2 * n):
+        count[i] = 2**31 - 1
+
+    pos1, pos2 = n - 1, n
+    for a in range(n - 1):
+        if pos1 >= 0 and count[pos1] < count[pos2]:
+            min1, pos1 = pos1, pos1 - 1
+        else:
+            min1, pos2 = pos2, pos2 + 1
+        if pos1 >= 0 and count[pos1] < count[pos2]:
+            min2, pos1 = pos1, pos1 - 1
+        else:
+            min2, pos2 = pos2, pos2 + 1
+        count[n + a] = count[min1] + count[min2]
+        parent[min1] = n + a
+        parent[min2] = n + a
+        binary[min2] = 1
+
+    root = 2 * n - 2
+    for i, w in enumerate(words):
+        code: List[int] = []
+        point: List[int] = []
+        b = i
+        while b != root:
+            code.append(binary[b])
+            point.append(b)
+            b = parent[b]
+        # leaf-to-root collected; reference emits root-first codes and points
+        # offset into the syn1 matrix (point - n), prefixed by the root.
+        depth = min(len(code), max_code_length)
+        codes = list(reversed(code))[:depth]
+        points = [root - n] + [p - n for p in reversed(point[1:])]
+        points = points[:depth]
+        w.codes = codes
+        w.points = points

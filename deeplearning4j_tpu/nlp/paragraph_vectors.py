@@ -1,0 +1,293 @@
+"""ParagraphVectors (doc2vec): DBOW + DM sequence learning algorithms.
+
+Capability mirror of the reference
+(deeplearning4j-nlp/.../models/paragraphvectors/ParagraphVectors.java:44 with
+sequence learning algorithms models/embeddings/learning/impl/sequence/
+DBOW.java and DM.java):
+  - DBOW: the document vector is the input row predicting each word of the
+    document through the word's Huffman path (skip-gram where the document
+    label plays the context-word role);
+  - DM: input = mean of (context-window word vectors + document vector),
+    predicting the center word (CBOW with the doc row mixed in);
+  - labels live in the same embedding space; here they get their own matrix
+    `doc_vectors` (cleaner than the reference's label-in-vocab trick, same
+    capability);
+  - inferVector: gradient steps on a fresh doc vector with frozen word
+    matrices (ParagraphVectors.inferVector).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.text import BasicLabelAwareIterator, LabelledDocument
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _pad_batch, _mean_scale, MAX_EXP
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _dbow_step(docvecs, syn1, doc_ids, points, codes, mask, alpha):
+    """HS update where the input row is a doc vector (DBOW.java)."""
+    l1 = docvecs[doc_ids]
+    s1 = syn1[points]
+    dot = jnp.einsum("bd,bld->bl", l1, s1)
+    live = mask * (jnp.abs(dot) < MAX_EXP)
+    f = jax.nn.sigmoid(dot)
+    g = (1.0 - codes - f) * alpha * live
+    neu1e = jnp.einsum("bl,bld->bd", g, s1)
+    s1_scale = _mean_scale(syn1.shape[0], points, live)
+    syn1 = syn1.at[points].add((g * s1_scale)[..., None] * l1[:, None, :])
+    d_live = (mask.sum(axis=1) > 0).astype(jnp.float32)
+    d_scale = _mean_scale(docvecs.shape[0], doc_ids, d_live)
+    docvecs = docvecs.at[doc_ids].add(d_scale[:, None] * neu1e)
+    return docvecs, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _dm_step(syn0, syn1, docvecs, doc_ids, ctx_idx, ctx_mask, points, codes, mask, alpha):
+    """DM: mean(context vectors + doc vector) predicts the center word
+    (DM.java); neu1e flows back into both context rows and the doc row."""
+    cvecs = syn0[ctx_idx]  # (B, C, D)
+    dvec = docvecs[doc_ids]  # (B, D)
+    denom = ctx_mask.sum(axis=1, keepdims=True) + 1.0
+    l1 = ((cvecs * ctx_mask[..., None]).sum(axis=1) + dvec) / denom
+    s1 = syn1[points]
+    dot = jnp.einsum("bd,bld->bl", l1, s1)
+    live = mask * (jnp.abs(dot) < MAX_EXP)
+    f = jax.nn.sigmoid(dot)
+    g = (1.0 - codes - f) * alpha * live
+    neu1e = jnp.einsum("bl,bld->bd", g, s1)
+    s1_scale = _mean_scale(syn1.shape[0], points, live)
+    syn1 = syn1.at[points].add((g * s1_scale)[..., None] * l1[:, None, :])
+    ctx_scale = _mean_scale(syn0.shape[0], ctx_idx, ctx_mask)
+    syn0 = syn0.at[ctx_idx].add(neu1e[:, None, :] * ctx_scale[..., None])
+    d_live = (mask.sum(axis=1) > 0).astype(jnp.float32)
+    d_scale = _mean_scale(docvecs.shape[0], doc_ids, d_live)
+    docvecs = docvecs.at[doc_ids].add(d_scale[:, None] * neu1e)
+    return syn0, syn1, docvecs
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _infer_dbow_step(docvec, syn1, points, codes, mask, alpha):
+    """DBOW step for ONE document vector with frozen syn1 (inferVector):
+    all rows share doc id 0, so updates are averaged over live rows."""
+    l1 = docvec[0]  # (D,)
+    s1 = syn1[points]  # (B, L, D)
+    dot = jnp.einsum("d,bld->bl", l1, s1)
+    live = mask * (jnp.abs(dot) < MAX_EXP)
+    f = jax.nn.sigmoid(dot)
+    g = (1.0 - codes - f) * alpha * live
+    n_live = jnp.maximum((mask.sum(axis=1) > 0).sum(), 1.0)
+    neu1e = jnp.einsum("bl,bld->d", g, s1) / jnp.sqrt(n_live)
+    return docvec.at[0].add(neu1e)
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, dm: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.dm = dm
+        self.labels: List[str] = []
+        self.doc_vectors: Optional[np.ndarray] = None
+
+    # -- fitting ----------------------------------------------------------
+    def fit_documents(self, documents: Iterable[LabelledDocument]) -> "ParagraphVectors":
+        docs = list(documents)
+        token_sequences = self._tokenize_corpus([d.content for d in docs])
+        if self.vocab is None:
+            self.build_vocab(token_sequences)
+        self._counts = np.array(
+            [wd.count for wd in self.vocab.vocab_words()], np.float64
+        )
+        self.labels = []
+        for d in docs:
+            for l in d.labels:
+                if l not in self.labels:
+                    self.labels.append(l)
+        label_to_id = {l: i for i, l in enumerate(self.labels)}
+        rng = np.random.default_rng(self.seed)
+        self.doc_vectors = (
+            (rng.random((len(self.labels), self.layer_size)) - 0.5) / self.layer_size
+        ).astype(np.float32)
+
+        # word co-training: run plain word2vec passes first (the reference
+        # trains words + labels jointly; DBOW only touches labels+syn1)
+        super().fit_tokens(token_sequences)
+
+        lt = self.lookup_table
+        P, C, M = lt.huffman_tensors()
+        docvecs = jnp.asarray(self.doc_vectors)
+        syn0 = jnp.asarray(lt.syn0)
+        syn1 = jnp.asarray(lt.syn1)
+
+        B = self.batch_size
+        n_phases = max(1, self.epochs * self.iterations)
+        for phase in range(n_phases):
+            if self.dm:
+                d_ids, centers, ctx, cmask = self._dm_examples(docs, label_to_id, rng)
+                nb = max(1, -(-len(centers) // B))
+                for bi in range(nb):
+                    sl = slice(bi * B, (bi + 1) * B)
+                    di, cen, cx, cm = d_ids[sl], centers[sl], ctx[sl], cmask[sl]
+                    if len(cen) == 0:
+                        continue
+                    npad = len(cen)
+                    di, cen = _pad_batch(di, B), _pad_batch(cen, B)
+                    cx, cm = _pad_batch(cx, B), _pad_batch(cm, B)
+                    pad_live = (np.arange(B) < npad).astype(np.float32)
+                    cm = cm * pad_live[:, None]
+                    alpha = self._alpha(phase, bi, n_phases, nb)
+                    syn0, syn1, docvecs = _dm_step(
+                        syn0, syn1, docvecs, jnp.asarray(di), jnp.asarray(cx),
+                        jnp.asarray(cm), jnp.asarray(P[cen]), jnp.asarray(C[cen]),
+                        jnp.asarray(M[cen] * pad_live[:, None]), jnp.float32(alpha),
+                    )
+            else:
+                d_ids, centers = self._dbow_pairs(docs, label_to_id, rng)
+                nb = max(1, -(-len(centers) // B))
+                for bi in range(nb):
+                    sl = slice(bi * B, (bi + 1) * B)
+                    di, cen = d_ids[sl], centers[sl]
+                    if len(cen) == 0:
+                        continue
+                    npad = len(cen)
+                    di, cen = _pad_batch(di, B), _pad_batch(cen, B)
+                    pad_live = (np.arange(B) < npad).astype(np.float32)
+                    alpha = self._alpha(phase, bi, n_phases, nb)
+                    docvecs, syn1 = _dbow_step(
+                        docvecs, syn1, jnp.asarray(di), jnp.asarray(P[cen]),
+                        jnp.asarray(C[cen]), jnp.asarray(M[cen] * pad_live[:, None]),
+                        jnp.float32(alpha),
+                    )
+
+        self.doc_vectors = np.asarray(docvecs)
+        lt.syn0 = np.asarray(syn0)
+        lt.syn1 = np.asarray(syn1)
+        return self
+
+    def fit_labelled(self, sentences: Sequence[str], labels: Optional[Sequence[str]] = None):
+        return self.fit_documents(BasicLabelAwareIterator(sentences, labels))
+
+    def _dbow_pairs(self, docs, label_to_id, rng):
+        d_ids, centers = [], []
+        for d in docs:
+            toks = self._tokenize_corpus([d.content])
+            idx = self._sequences_as_indices(toks)
+            if not idx:
+                continue
+            seq = self._subsample(idx[0], rng)
+            for l in d.labels:
+                li = label_to_id[l]
+                for w in seq:
+                    d_ids.append(li)
+                    centers.append(w)
+        if not centers:
+            return np.zeros((0,), np.int32), np.zeros((0,), np.int32)
+        order = rng.permutation(len(centers))
+        return (
+            np.asarray(d_ids, np.int32)[order],
+            np.asarray(centers, np.int32)[order],
+        )
+
+    def _dm_examples(self, docs, label_to_id, rng):
+        w = self.window
+        width = 2 * w
+        d_ids, centers, ctx, cmask = [], [], [], []
+        for d in docs:
+            toks = self._tokenize_corpus([d.content])
+            idx = self._sequences_as_indices(toks)
+            if not idx:
+                continue
+            seq = self._subsample(idx[0], rng)
+            n = len(seq)
+            bs = rng.integers(0, w, size=max(1, n))
+            for l in d.labels:
+                li = label_to_id[l]
+                for i in range(n):
+                    b = bs[i]
+                    lo, hi = max(0, i - w + b), min(n, i + w - b + 1)
+                    win = [seq[c] for c in range(lo, hi) if c != i]
+                    row = np.zeros((width,), np.int32)
+                    m = np.zeros((width,), np.float32)
+                    row[: len(win)] = win
+                    m[: len(win)] = 1.0
+                    d_ids.append(li)
+                    centers.append(seq[i])
+                    ctx.append(row)
+                    cmask.append(m)
+        if not centers:
+            z = np.zeros((0, width), np.int32)
+            return (np.zeros((0,), np.int32), np.zeros((0,), np.int32), z,
+                    z.astype(np.float32))
+        order = rng.permutation(len(centers))
+        return (
+            np.asarray(d_ids, np.int32)[order],
+            np.asarray(centers, np.int32)[order],
+            np.stack(ctx)[order],
+            np.stack(cmask)[order],
+        )
+
+    # -- query ------------------------------------------------------------
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        try:
+            return self.doc_vectors[self.labels.index(label)]
+        except ValueError:
+            return None
+
+    def similarity_to_label(self, label1: str, label2: str) -> float:
+        v1, v2 = self.doc_vector(label1), self.doc_vector(label2)
+        if v1 is None or v2 is None:
+            return float("nan")
+        denom = float(np.linalg.norm(v1) * np.linalg.norm(v2)) or 1.0
+        return float(np.dot(v1, v2) / denom)
+
+    _INFER_PAD = 64  # fixed sequence pad so the jitted step compiles once
+
+    def infer_vector(self, text: str, steps: int = 10) -> np.ndarray:
+        """Train ONE fresh doc vector against frozen word matrices
+        (ParagraphVectors.inferVector). syn1 stays frozen on device (no
+        donation, no syn1 update); the sequence is padded to a fixed length
+        so all documents share one compiled step."""
+        lt = self.lookup_table
+        toks = self._tokenize_corpus([text])
+        idx = self._sequences_as_indices(toks)
+        rng = np.random.default_rng(self.seed)
+        vec = ((rng.random((1, self.layer_size)) - 0.5) / self.layer_size).astype(
+            np.float32
+        )
+        if not idx or not len(idx[0]):
+            return vec[0]
+        P, C, M = lt.huffman_tensors()
+        seq = idx[0][: self._INFER_PAD]
+        n = len(seq)
+        seq = _pad_batch(seq, self._INFER_PAD)
+        live = (np.arange(self._INFER_PAD) < n).astype(np.float32)
+        points = jnp.asarray(P[seq])
+        codes = jnp.asarray(C[seq])
+        mask = jnp.asarray(M[seq] * live[:, None])
+        syn1 = jnp.asarray(lt.syn1)
+        docvec = jnp.asarray(vec)
+        for step in range(steps):
+            alpha = max(
+                self.min_learning_rate,
+                self.learning_rate * (1.0 - step / max(1, steps)),
+            )
+            docvec = _infer_dbow_step(
+                docvec, syn1, points, codes, mask, jnp.float32(alpha)
+            )
+        return np.asarray(docvec)[0]
+
+    def nearest_labels(self, text_or_vec, top_n: int = 5) -> List[str]:
+        v = (
+            self.infer_vector(text_or_vec)
+            if isinstance(text_or_vec, str)
+            else np.asarray(text_or_vec, np.float32)
+        )
+        norms = np.linalg.norm(self.doc_vectors, axis=1)
+        norms = np.where(norms == 0, 1.0, norms)
+        sims = self.doc_vectors @ v / (norms * (np.linalg.norm(v) or 1.0))
+        order = np.argsort(-sims)[:top_n]
+        return [self.labels[int(i)] for i in order]

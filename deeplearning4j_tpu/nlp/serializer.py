@@ -1,0 +1,122 @@
+"""Word-vector model IO.
+
+Capability mirror of the reference WordVectorSerializer
+(deeplearning4j-nlp/.../models/embeddings/loader/WordVectorSerializer.java):
+  - writeWordVectors / loadTxtVectors: text format, one `word v1 v2 ...`
+    line per vocab word (interoperable with original word2vec text output);
+  - full-model save/load including syn1/syn1neg + vocab counts + Huffman
+    codes so training can resume (the reference's writeFullModel), realized
+    as an .npz + JSON-ish sidecar in one file.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def write_word_vectors(model, path: str) -> None:
+    """Text format: `word x1 x2 ... xD` per line (writeWordVectors)."""
+    lt = model.lookup_table if hasattr(model, "lookup_table") else model
+    vocab = lt.vocab
+    with open(path, "w", encoding="utf-8") as f:
+        for w in vocab.vocab_words():
+            vec = lt.syn0[w.index]
+            f.write(w.word + " " + " ".join(f"{v:.8g}" for v in vec) + "\n")
+
+
+def read_word_vectors(path: str) -> InMemoryLookupTable:
+    """Inverse of write_word_vectors (loadTxtVectors): builds a query-only
+    lookup table (counts unknown → all 1)."""
+    words, rows = [], []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < 2:
+                continue
+            words.append(parts[0])
+            rows.append(np.array([float(x) for x in parts[1:]], np.float32))
+    vocab = VocabCache()
+    for w in words:
+        vocab.add_token(w)
+    vocab.finalize_vocab(1)
+    # preserve file order as index order (finalize sorts by count; all counts
+    # equal so it sorted alphabetically — rebuild explicitly)
+    vocab._by_index = [vocab._words[w] for w in words]
+    for i, w in enumerate(words):
+        vocab._words[w].index = i
+    lt = InMemoryLookupTable(vocab, rows[0].shape[0] if rows else 1)
+    lt.syn0 = np.stack(rows) if rows else lt.syn0
+    return lt
+
+
+def save_word2vec(model: Word2Vec, path: str) -> None:
+    """Full model: config + vocab (counts, codes, points) + matrices in one
+    zip (reference writeFullModel three-part analog, same shape as the
+    framework's ModelSerializer checkpoint: config json + binary arrays)."""
+    conf = {
+        "layer_size": model.layer_size,
+        "window": model.window,
+        "min_word_frequency": model.min_word_frequency,
+        "learning_rate": model.learning_rate,
+        "min_learning_rate": model.min_learning_rate,
+        "epochs": model.epochs,
+        "iterations": model.iterations,
+        "negative": model.negative,
+        "sampling": model.sampling,
+        "seed": model.seed,
+        "use_cbow": model.use_cbow,
+    }
+    vocab_rows = [
+        {"word": w.word, "count": w.count, "codes": w.codes, "points": w.points}
+        for w in model.vocab.vocab_words()
+    ]
+    lt = model.lookup_table
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(conf))
+        zf.writestr("vocab.json", json.dumps(vocab_rows))
+        buf = io.BytesIO()
+        arrays = {"syn0": lt.syn0, "syn1": lt.syn1}
+        if lt.syn1neg is not None:
+            arrays["syn1neg"] = lt.syn1neg
+        np.savez(buf, **arrays)
+        zf.writestr("coefficients.npz", buf.getvalue())
+
+
+def load_word2vec(path: str) -> Word2Vec:
+    with zipfile.ZipFile(path, "r") as zf:
+        conf = json.loads(zf.read("configuration.json"))
+        vocab_rows = json.loads(zf.read("vocab.json"))
+        arrays = np.load(io.BytesIO(zf.read("coefficients.npz")))
+        model = Word2Vec(**conf)
+        vocab = VocabCache()
+        for row in vocab_rows:
+            vw = vocab.add_token(row["word"], row["count"])
+            vw.count = row["count"]  # add_token adds; set exact
+        vocab.finalize_vocab(1)
+        # restore exact order + codes
+        by_word = {r["word"]: r for r in vocab_rows}
+        vocab._by_index = [vocab._words[r["word"]] for r in vocab_rows]
+        for i, r in enumerate(vocab_rows):
+            vw = vocab._words[r["word"]]
+            vw.index = i
+            vw.codes = list(r["codes"])
+            vw.points = list(r["points"])
+        model.vocab = vocab
+        lt = InMemoryLookupTable(
+            vocab, conf["layer_size"], seed=conf["seed"], negative=conf["negative"]
+        )
+        lt.syn0 = arrays["syn0"]
+        lt.syn1 = arrays["syn1"]
+        if "syn1neg" in arrays:
+            lt.syn1neg = arrays["syn1neg"]
+        model.lookup_table = lt
+        return model

@@ -1,0 +1,241 @@
+"""Text infrastructure: tokenizers, sentence/document iterators, stopwords.
+
+Capability mirror of the reference's text stack (SURVEY.md section 2.4,
+deeplearning4j-nlp "Text infra", 73 files):
+  - TokenizerFactory / Tokenizer (text/tokenization/tokenizerfactory/
+    DefaultTokenizerFactory.java, NGramTokenizerFactory.java) with an
+    optional TokenPreProcess (CommonPreprocessor: lowercase + strip
+    punctuation);
+  - SentenceIterator family (text/sentenceiterator/): LineSentenceIterator,
+    FileSentenceIterator (directory walk), CollectionSentenceIterator,
+    AggregatingSentenceIterator, with an optional SentencePreProcessor;
+  - label-aware iterators for ParagraphVectors
+    (text/documentiterator/LabelAwareIterator.java, LabelledDocument);
+  - stopwords (the reference bundles a stopwords resource loaded by
+    org.deeplearning4j.text.stopwords.StopWords).
+
+Pure host-side Python — tokenization never touches the device; the device
+consumes only integer index batches assembled downstream.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+# The reference ships a stopwords list resource (stopwords file under
+# deeplearning4j-nlp resources); this is the standard English set it uses.
+STOP_WORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with he she his her
+    him from we you your i me my our us were been has have had do does did
+    what when where who whom which why how all any both each few more most
+    other some than too very can just should now""".split()
+)
+
+_PUNCT_RE = re.compile(r"[^\w]+", re.UNICODE)
+
+
+def common_preprocessor(token: str) -> str:
+    """Lowercase + strip punctuation/digits-adjacent symbols (reference
+    text/tokenization/tokenizer/preprocessor/CommonPreprocessor.java)."""
+    return _PUNCT_RE.sub("", token.lower())
+
+
+class Tokenizer:
+    """A tokenizer over one string (reference Tokenizer interface:
+    hasMoreTokens/nextToken/getTokens)."""
+
+    def __init__(self, tokens: List[str]):
+        self._tokens = tokens
+        self._pos = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._pos < len(self._tokens)
+
+    def next_token(self) -> str:
+        tok = self._tokens[self._pos]
+        self._pos += 1
+        return tok
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+
+
+class DefaultTokenizerFactory:
+    """Whitespace tokenizer + optional per-token preprocessor (reference
+    DefaultTokenizerFactory.java wrapping DefaultTokenizer — a
+    StringTokenizer over whitespace)."""
+
+    def __init__(self, preprocessor: Optional[Callable[[str], str]] = None):
+        self.preprocessor = preprocessor
+
+    def create(self, text: str) -> Tokenizer:
+        toks = text.split()
+        if self.preprocessor is not None:
+            toks = [self.preprocessor(t) for t in toks]
+        return Tokenizer([t for t in toks if t])
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()
+
+
+class NGramTokenizerFactory:
+    """n-gram tokenizer (reference NGramTokenizerFactory.java): emits all
+    n-grams for n in [min_n, max_n] joined by spaces."""
+
+    def __init__(
+        self,
+        base: Optional[DefaultTokenizerFactory] = None,
+        min_n: int = 1,
+        max_n: int = 1,
+    ):
+        self.base = base or DefaultTokenizerFactory()
+        self.min_n = min_n
+        self.max_n = max_n
+
+    def create(self, text: str) -> Tokenizer:
+        unigrams = self.base.tokenize(text)
+        out: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            if n == 1:
+                out.extend(unigrams)
+            else:
+                for i in range(len(unigrams) - n + 1):
+                    out.append(" ".join(unigrams[i : i + n]))
+        return Tokenizer(out)
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()
+
+
+# ---------------------------------------------------------------------------
+# Sentence iterators
+# ---------------------------------------------------------------------------
+
+
+class SentenceIterator:
+    """Reference text/sentenceiterator/SentenceIterator.java:
+    nextSentence/hasNext/reset, with optional preprocessor."""
+
+    def __init__(self, preprocessor: Optional[Callable[[str], str]] = None):
+        self.preprocessor = preprocessor
+
+    def _iter(self) -> Iterator[str]:  # subclass hook
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[str]:
+        for s in self._iter():
+            yield self.preprocessor(s) if self.preprocessor else s
+
+    def reset(self) -> None:
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    """In-memory list of sentences (reference CollectionSentenceIterator.java)."""
+
+    def __init__(self, sentences: Sequence[str], preprocessor=None):
+        super().__init__(preprocessor)
+        self.sentences = list(sentences)
+
+    def _iter(self) -> Iterator[str]:
+        return iter(self.sentences)
+
+
+class LineSentenceIterator(SentenceIterator):
+    """One sentence per line of a file (reference LineSentenceIterator.java /
+    BasicLineIterator)."""
+
+    def __init__(self, path: str, preprocessor=None, encoding: str = "utf-8"):
+        super().__init__(preprocessor)
+        self.path = path
+        self.encoding = encoding
+
+    def _iter(self) -> Iterator[str]:
+        with open(self.path, "r", encoding=self.encoding) as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line:
+                    yield line
+
+
+class FileSentenceIterator(SentenceIterator):
+    """Walks a directory, each file's lines are sentences (reference
+    FileSentenceIterator.java)."""
+
+    def __init__(self, root: str, preprocessor=None, encoding: str = "utf-8"):
+        super().__init__(preprocessor)
+        self.root = root
+        self.encoding = encoding
+
+    def _iter(self) -> Iterator[str]:
+        if os.path.isfile(self.root):
+            paths = [self.root]
+        else:
+            paths = []
+            for dirpath, _dirs, files in os.walk(self.root):
+                for name in sorted(files):
+                    paths.append(os.path.join(dirpath, name))
+        for p in sorted(paths):
+            yield from LineSentenceIterator(p, encoding=self.encoding)._iter()
+
+
+class AggregatingSentenceIterator(SentenceIterator):
+    """Chains several sentence iterators (reference
+    AggregatingSentenceIterator.java builder)."""
+
+    def __init__(self, iterators: Sequence[SentenceIterator], preprocessor=None):
+        super().__init__(preprocessor)
+        self.iterators = list(iterators)
+
+    def _iter(self) -> Iterator[str]:
+        for it in self.iterators:
+            yield from it._iter()
+
+
+# ---------------------------------------------------------------------------
+# Label-aware documents (ParagraphVectors input)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LabelledDocument:
+    """Reference text/documentiterator/LabelledDocument.java: content +
+    label(s)."""
+
+    content: str
+    labels: List[str] = field(default_factory=list)
+
+
+class BasicLabelAwareIterator:
+    """Labels each sentence (reference BasicLabelAwareIterator.java: wraps a
+    SentenceIterator and generates DOC_<n> labels, or takes explicit
+    (sentence, label) pairs)."""
+
+    def __init__(
+        self,
+        sentences: Iterable[str],
+        labels: Optional[Sequence[str]] = None,
+        label_prefix: str = "DOC_",
+    ):
+        self.documents: List[LabelledDocument] = []
+        for i, s in enumerate(sentences):
+            label = labels[i] if labels is not None else f"{label_prefix}{i}"
+            self.documents.append(LabelledDocument(content=s, labels=[label]))
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        return iter(self.documents)
+
+    def all_labels(self) -> List[str]:
+        out: List[str] = []
+        for d in self.documents:
+            for l in d.labels:
+                if l not in out:
+                    out.append(l)
+        return out

@@ -1,0 +1,119 @@
+"""Vocabulary: VocabWord, VocabCache, VocabConstructor.
+
+Capability mirror of the reference vocab store (SURVEY.md section 2.4):
+  - VocabWord / SequenceElement (models/word2vec/VocabWord.java — word,
+    frequency, index, Huffman codes+points);
+  - VocabCache / AbstractCache (models/word2vec/wordstore/inmemory/
+    AbstractCache.java — word<->index maps, frequency counts,
+    totalWordOccurrences);
+  - VocabConstructor (models/word2vec/wordstore/VocabConstructor.java —
+    scans corpora, counts tokens, applies minWordFrequency, fixes indices,
+    builds Huffman codes).
+
+Index convention follows the reference: words are sorted by descending
+frequency and indexed 0..n-1 (SequenceVectors.buildVocab →
+AbstractCache.updateWordsOccurencies / VocabConstructor.buildJointVocabulary).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from deeplearning4j_tpu.nlp.huffman import build_huffman
+
+
+@dataclass
+class VocabWord:
+    """Reference models/word2vec/VocabWord.java: element + frequency + Huffman
+    code path (codes = left/right bits, points = inner-node indices)."""
+
+    word: str
+    count: float = 1.0
+    index: int = -1
+    codes: List[int] = field(default_factory=list)
+    points: List[int] = field(default_factory=list)
+
+    @property
+    def code_length(self) -> int:
+        return len(self.codes)
+
+
+class VocabCache:
+    """Word<->index store with counts (reference AbstractCache.java)."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_word_occurrences: float = 0.0
+
+    # -- construction -----------------------------------------------------
+    def add_token(self, word: str, count: float = 1.0) -> VocabWord:
+        vw = self._words.get(word)
+        if vw is None:
+            vw = VocabWord(word=word, count=0.0)
+            self._words[word] = vw
+        vw.count += count
+        return vw
+
+    def finalize_vocab(self, min_word_frequency: int = 1) -> None:
+        """Drop rare words, sort by descending frequency, assign indices, and
+        recompute totals (VocabConstructor.buildJointVocabulary semantics)."""
+        kept = [w for w in self._words.values() if w.count >= min_word_frequency]
+        kept.sort(key=lambda w: (-w.count, w.word))
+        self._words = {w.word: w for w in kept}
+        self._by_index = kept
+        for i, w in enumerate(kept):
+            w.index = i
+        self.total_word_occurrences = float(sum(w.count for w in kept))
+
+    def build_huffman(self) -> None:
+        """Attach Huffman codes/points to every word (reference Huffman.build
+        applied in SequenceVectors.buildVocab)."""
+        build_huffman(self._by_index)
+
+    # -- queries ----------------------------------------------------------
+    def __contains__(self, word: str) -> bool:
+        return word in self._words
+
+    def __len__(self) -> int:
+        return len(self._by_index)
+
+    def num_words(self) -> int:
+        return len(self._by_index)
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+
+    def index_of(self, word: str) -> int:
+        vw = self._words.get(word)
+        return -1 if vw is None else vw.index
+
+    def word_at_index(self, index: int) -> str:
+        return self._by_index[index].word
+
+    def vocab_words(self) -> List[VocabWord]:
+        return list(self._by_index)
+
+    def word_frequency(self, word: str) -> float:
+        vw = self._words.get(word)
+        return 0.0 if vw is None else vw.count
+
+
+class VocabConstructor:
+    """Scans tokenized corpora into a finalized VocabCache (reference
+    VocabConstructor.java)."""
+
+    def __init__(self, min_word_frequency: int = 1, build_huffman_tree: bool = True):
+        self.min_word_frequency = min_word_frequency
+        self.build_huffman_tree = build_huffman_tree
+
+    def build(self, token_sequences: Iterable[Sequence[str]]) -> VocabCache:
+        cache = VocabCache()
+        for seq in token_sequences:
+            for tok in seq:
+                cache.add_token(tok)
+        cache.finalize_vocab(self.min_word_frequency)
+        if self.build_huffman_tree:
+            cache.build_huffman()
+        return cache

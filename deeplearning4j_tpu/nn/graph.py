@@ -452,6 +452,9 @@ class ComputationGraph:
             if label_masks is not None
             else None
         )
+        if self.conf.backprop_type == "truncated_bptt":
+            # before solver dispatch, same precedence as MultiLayerNetwork.fit
+            return self._fit_tbptt(inputs, labels_l, masks_d, lmasks)
         if self.conf.optimization_algo != "stochastic_gradient_descent":
             from deeplearning4j_tpu.optimize.solvers import Solver
 
@@ -470,6 +473,87 @@ class ComputationGraph:
                 srng,
                 masks_d,
                 lmasks,
+            )
+            self._record_iteration(loss)
+        return loss
+
+    def _reset_rnn_states(self, batch_n: int) -> None:
+        """Zero recurrent state sized for this batch (sequence start — the
+        graph analog of MLN's reset before doTruncatedBPTT :1162)."""
+        for n in self.layer_names:
+            lc = self.conf.vertices[n]
+            if isinstance(lc, STATEFUL_RNN_CONFS):
+                self.states[n] = {
+                    k: jnp.zeros((batch_n, lc.n_out), jnp.float32)
+                    for k in self.states[n]
+                }
+
+    def _fit_tbptt(self, inputs, labels_l, masks_d, lmasks) -> float:
+        """Truncated BPTT over a DAG (reference ComputationGraph supports
+        BackpropType.TruncatedBPTT the same way MLN does :1162-1233): slice
+        the time axis into fwd-length windows, carry recurrent state across
+        windows (stop-gradient at the boundary — state enters the next jitted
+        step as data).
+
+        Like MLN's _fit_tbptt, the backprop window equals the forward window
+        (tbptt_back_length beyond the window is not separately truncated — a
+        warning is emitted when the two differ)."""
+        seq_inputs = {k: v for k, v in inputs.items() if v.ndim == 3}
+        if not seq_inputs:
+            raise ValueError(
+                "backprop_type='truncated_bptt' requires at least one "
+                "time-series ([B,T,F]) input"
+            )
+        if self.conf.tbptt_back_length != self.conf.tbptt_fwd_length:
+            import warnings
+
+            warnings.warn(
+                "tbptt_back_length != tbptt_fwd_length: gradients are "
+                "truncated at the forward-window boundary (back length "
+                "ignored)", stacklevel=3,
+            )
+        first_seq = next(iter(seq_inputs.values()))
+        t_total = first_seq.shape[1]
+        w = self.conf.tbptt_fwd_length
+        batch_n = first_seq.shape[0]
+        self._reset_rnn_states(batch_n)
+        step = self._get_train_step(len(labels_l), lmasks is not None, carry_state=True)
+        loss = float("nan")
+        for window_start in range(0, t_total, w):
+            sl = slice(window_start, min(window_start + w, t_total))
+            in_w = {k: v[:, sl] if v.ndim == 3 else v for k, v in inputs.items()}
+            lb_w = [l[:, sl] if l.ndim == 3 else l for l in labels_l]
+            # slice a mask only when it spans the time axis (same guard the
+            # labels/inputs get: per-example 2D masks pass through whole)
+            mk_w = (
+                {
+                    k: (m[:, sl] if m.ndim >= 2 and m.shape[1] == t_total else m)
+                    for k, m in masks_d.items()
+                }
+                if masks_d
+                else masks_d
+            )
+            lm_w = (
+                [
+                    m[:, sl]
+                    if m is not None and labels_l[i].ndim == 3
+                    else m
+                    for i, m in enumerate(lmasks)
+                ]
+                if lmasks
+                else lmasks
+            )
+            srng = rng_mod.step_key(self._rng, self.iteration)
+            self.params, self.states, self.updater_state, loss = step(
+                self.params,
+                self.states,
+                self.updater_state,
+                in_w,
+                lb_w,
+                jnp.asarray(self.iteration, jnp.int32),
+                srng,
+                mk_w,
+                lm_w,
             )
             self._record_iteration(loss)
         return loss

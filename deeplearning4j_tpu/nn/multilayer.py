@@ -354,6 +354,18 @@ class MultiLayerNetwork:
         recurrent state carries forward across windows (stop-gradient at the
         window boundary — state enters the next jitted step as data), matching
         reference doTruncatedBPTT :1162-1233."""
+        if features.ndim != 3:
+            raise ValueError(
+                "backprop_type='truncated_bptt' requires [B,T,F] features"
+            )
+        if self.conf.tbptt_back_length != self.conf.tbptt_fwd_length:
+            import warnings
+
+            warnings.warn(
+                "tbptt_back_length != tbptt_fwd_length: gradients are "
+                "truncated at the forward-window boundary (back length "
+                "ignored)", stacklevel=3,
+            )
         t_total = features.shape[1]
         w = self.conf.tbptt_fwd_length
         loss = float("nan")
@@ -362,8 +374,16 @@ class MultiLayerNetwork:
             sl = slice(window_start, min(window_start + w, t_total))
             f_w = features[:, sl]
             l_w = labels[:, sl] if labels.ndim == 3 else labels
-            m_w = mask[:, sl] if mask is not None else None
-            lm_w = label_mask[:, sl] if label_mask is not None else None
+            m_w = (
+                mask[:, sl]
+                if mask is not None and mask.ndim >= 2 and mask.shape[1] == t_total
+                else mask
+            )
+            lm_w = (
+                label_mask[:, sl]
+                if label_mask is not None and labels.ndim == 3
+                else label_mask
+            )
             step = self._get_train_step(
                 m_w is not None, lm_w is not None, carry_state=True
             )

@@ -568,3 +568,43 @@ class TestGraphMasking:
             float(s_masked_clean), float(s_masked_corrupt), rtol=1e-6
         )
         assert abs(float(s_masked_clean) - float(full)) > 1e-9
+
+
+class TestGraphTbptt:
+    def test_truncated_bptt_fit_carries_state_across_windows(self):
+        """ComputationGraph honors BackpropType.TruncatedBPTT (reference
+        supports TBPTT on graphs the same as on MLN :1162-1233): the time
+        axis is sliced into fwd-length windows, one optimizer iteration per
+        window."""
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(7)
+            .learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=6, activation="tanh"), "in")
+            .add_layer(
+                "out",
+                RnnOutputLayer(
+                    n_in=6, n_out=2, activation="softmax", loss_function="mcxent"
+                ),
+                "lstm",
+            )
+            .set_outputs("out")
+            .backprop_type("truncated_bptt")
+            .t_bptt_forward_length(4)
+            .t_bptt_backward_length(4)
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5, 12, 3)).astype(np.float32)  # T=12 -> 3 windows
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (5, 12))]
+        it0 = net.iteration
+        loss = net.fit(x, y)
+        assert np.isfinite(float(loss))
+        assert net.iteration - it0 == 3  # one iteration per window
+        # training should reduce the loss over repeats
+        for _ in range(10):
+            loss2 = net.fit(x, y)
+        assert float(loss2) < float(loss)

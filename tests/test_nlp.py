@@ -1,0 +1,220 @@
+"""NLP stack tests — mirrors the reference's nlp test strategy (SURVEY.md
+section 4 "NLP corpus tests": train embeddings on a small corpus, assert
+similarity sanity, e.g. Word2VecTests.java similarity("day","night") > x;
+tokenizer/vocab/serializer unit tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    CollectionSentenceIterator,
+    DefaultTokenizerFactory,
+    Glove,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    TfidfVectorizer,
+    VocabConstructor,
+    Word2Vec,
+    build_huffman,
+    load_word2vec,
+    read_word_vectors,
+    save_word2vec,
+    write_word_vectors,
+)
+from deeplearning4j_tpu.nlp.text import common_preprocessor
+from deeplearning4j_tpu.nlp.vocab import VocabWord
+
+
+def make_corpus(n=300, seed=7):
+    """Synthetic corpus with two topical clusters so that in-cluster words
+    land nearer each other than cross-cluster."""
+    rng = np.random.default_rng(seed)
+    time_words = ["day", "night", "morning", "evening", "noon"]
+    animal_words = ["cat", "dog", "bird", "fish", "horse"]
+    sents = []
+    for _ in range(n):
+        if rng.random() < 0.5:
+            w1, w2 = rng.choice(time_words, 2, replace=False)
+            sents.append(f"the {w1} follows the {w2} in time always")
+        else:
+            w1, w2 = rng.choice(animal_words, 2, replace=False)
+            sents.append(f"a {w1} chased a {w2} around the yard")
+    return sents
+
+
+class TestTokenizers:
+    def test_default_tokenizer_preprocessing(self):
+        tf = DefaultTokenizerFactory(common_preprocessor)
+        assert tf.tokenize("Hello, World! 123") == ["hello", "world", "123"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(min_n=1, max_n=2)
+        toks = tf.tokenize("a b c")
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+    def test_sentence_iterator_reset_semantics(self):
+        it = CollectionSentenceIterator(["s one", "s two"])
+        assert list(it) == ["s one", "s two"]
+        assert list(it) == ["s one", "s two"]  # re-iterable
+
+
+class TestVocabHuffman:
+    def test_vocab_indices_sorted_by_frequency(self):
+        vocab = VocabConstructor(min_word_frequency=2).build(
+            [["a", "a", "a", "b", "b", "c"], ["a", "b", "c"]]
+        )
+        assert vocab.num_words() == 3
+        assert vocab.word_at_index(0) == "a"  # most frequent first
+        assert vocab.word_frequency("a") == 4
+
+    def test_min_word_frequency_filters(self):
+        vocab = VocabConstructor(min_word_frequency=3).build(
+            [["a", "a", "a", "b"], ["b", "c"]]
+        )
+        assert "c" not in vocab
+        assert "a" in vocab
+
+    def test_huffman_prefix_free_and_frequency_ordered(self):
+        words = [VocabWord(word=f"w{i}", count=c, index=i)
+                 for i, c in enumerate([100, 50, 20, 10, 5, 2, 1])]
+        build_huffman(words)
+        codes = ["".join(map(str, w.codes)) for w in words]
+        # prefix-free
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+        # most frequent word has the (joint-)shortest code
+        assert len(codes[0]) == min(len(c) for c in codes)
+        # points index internal nodes of syn1 (0..n-2)
+        for w in words:
+            assert len(w.points) == len(w.codes)
+            assert all(0 <= p <= len(words) - 2 for p in w.points)
+
+
+class TestWord2Vec:
+    @pytest.fixture(scope="class")
+    def trained(self):
+        vec = Word2Vec(layer_size=32, window=3, min_word_frequency=1,
+                       epochs=5, seed=42, batch_size=512, learning_rate=0.05)
+        return vec.fit(make_corpus())
+
+    def test_topical_similarity(self, trained):
+        # in-cluster beats cross-cluster (Word2VecTests-style sanity)
+        assert trained.similarity("day", "night") > trained.similarity("day", "cat")
+        assert trained.similarity("cat", "dog") > trained.similarity("cat", "evening")
+
+    def test_words_nearest(self, trained):
+        near = trained.words_nearest("day", top_n=4)
+        assert len(near) == 4 and "day" not in near
+        # at least one fellow time-word in the top neighbors
+        assert set(near) & {"night", "morning", "evening", "noon"}
+
+    def test_get_word_vector_shape(self, trained):
+        v = trained.get_word_vector("day")
+        assert v.shape == (32,)
+        assert trained.get_word_vector("zzz_missing") is None
+
+    def test_negative_sampling_path(self):
+        vec = Word2Vec(layer_size=16, window=3, epochs=3, seed=1,
+                       negative=5, batch_size=256)
+        vec.fit(make_corpus(n=120))
+        assert vec.similarity("day", "night") > vec.similarity("day", "dog") - 0.5
+        assert vec.lookup_table.syn1neg is not None
+
+    def test_cbow_path(self):
+        vec = Word2Vec(layer_size=16, window=3, epochs=3, seed=1,
+                       use_cbow=True, batch_size=256)
+        vec.fit(make_corpus(n=120))
+        assert np.isfinite(vec.lookup_table.syn0).all()
+
+    def test_subsampling_runs(self):
+        vec = Word2Vec(layer_size=8, window=2, epochs=1, sampling=1e-3)
+        vec.fit(make_corpus(n=60))
+        assert np.isfinite(vec.lookup_table.syn0).all()
+
+    def test_deterministic_given_seed(self):
+        a = Word2Vec(layer_size=8, window=2, epochs=1, seed=9).fit(make_corpus(n=50))
+        b = Word2Vec(layer_size=8, window=2, epochs=1, seed=9).fit(make_corpus(n=50))
+        np.testing.assert_allclose(a.lookup_table.syn0, b.lookup_table.syn0,
+                                   rtol=1e-6)
+
+
+class TestSerializer:
+    def test_text_roundtrip(self, tmp_path):
+        vec = Word2Vec(layer_size=8, epochs=1).fit(make_corpus(n=40))
+        p = str(tmp_path / "vectors.txt")
+        write_word_vectors(vec, p)
+        lt = read_word_vectors(p)
+        for w in ["day", "cat", "the"]:
+            np.testing.assert_allclose(
+                lt.vector(w), vec.get_word_vector(w), rtol=1e-5
+            )
+
+    def test_full_model_roundtrip(self, tmp_path):
+        vec = Word2Vec(layer_size=8, epochs=1, negative=3).fit(make_corpus(n=40))
+        p = str(tmp_path / "w2v.zip")
+        save_word2vec(vec, p)
+        restored = load_word2vec(p)
+        np.testing.assert_allclose(restored.lookup_table.syn0, vec.lookup_table.syn0)
+        np.testing.assert_allclose(restored.lookup_table.syn1, vec.lookup_table.syn1)
+        assert restored.vocab.num_words() == vec.vocab.num_words()
+        w = vec.vocab.vocab_words()[0]
+        rw = restored.vocab.word_for(w.word)
+        assert rw.codes == w.codes and rw.points == w.points
+
+
+class TestGlove:
+    def test_glove_trains_and_loss_decreases(self):
+        g = Glove(layer_size=16, epochs=8, window=5, seed=3, x_max=10.0)
+        g.fit(make_corpus(n=200))
+        assert g.losses[-1] < g.losses[0]
+        assert g.similarity("day", "night") > g.similarity("day", "fish") - 0.5
+        assert len(g.words_nearest("cat", 3)) == 3
+
+
+class TestParagraphVectors:
+    def test_dbow_labels(self):
+        sents = make_corpus(n=80)
+        labels = ["TIME" if ("day" in s or "night" in s or "noon" in s or
+                             "morning" in s or "evening" in s) else "ANIMAL"
+                  for s in sents]
+        pv = ParagraphVectors(layer_size=16, epochs=3, seed=5, batch_size=256)
+        pv.fit_labelled(sents, labels)
+        assert pv.doc_vector("TIME") is not None
+        assert pv.doc_vector("ANIMAL") is not None
+        assert np.isfinite(pv.doc_vectors).all()
+
+    def test_dm_and_infer(self):
+        sents = make_corpus(n=60)
+        pv = ParagraphVectors(dm=True, layer_size=8, epochs=2, seed=5,
+                              batch_size=128)
+        pv.fit_labelled(sents)  # auto DOC_n labels
+        v = pv.infer_vector("the day follows the night")
+        assert v.shape == (8,)
+        labels = pv.nearest_labels("the day follows the night", top_n=3)
+        assert len(labels) == 3
+
+
+class TestVectorizers:
+    def test_bag_of_words(self):
+        bow = BagOfWordsVectorizer().fit(["a b b c", "a c c d"])
+        v = bow.transform("b b a")
+        assert v[bow.vocab.index_of("b")] == 2.0
+        assert v[bow.vocab.index_of("a")] == 1.0
+
+    def test_tfidf_downweights_common(self):
+        tf = TfidfVectorizer().fit(["a b", "a c", "a d"])
+        v = tf.transform("a b")
+        # 'a' appears in all docs -> idf 0; 'b' in one -> positive weight
+        assert v[tf.vocab.index_of("a")] == pytest.approx(0.0)
+        assert v[tf.vocab.index_of("b")] > 0
+
+    def test_vectorize_dataset(self):
+        bow = BagOfWordsVectorizer().fit(["good movie", "bad movie"])
+        ds = bow.vectorize(["good movie", "bad movie"], ["pos", "neg"])
+        assert ds.features.shape[0] == 2
+        assert ds.labels.shape == (2, 2)
