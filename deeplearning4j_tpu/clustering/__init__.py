@@ -1,0 +1,24 @@
+"""Clustering + spatial trees — capability surface of the reference
+clustering package (SURVEY.md section 2.1 "clustering", 33 files / 4,037
+LoC): KMeansClustering over BaseClusteringAlgorithm with strategies /
+termination conditions, and the spatial index structures KDTree, QuadTree,
+SPTree (Barnes-Hut), VPTree (nearest-neighbors; backs the UI explorer and
+Barnes-Hut t-SNE)."""
+
+from deeplearning4j_tpu.clustering.cluster import Cluster, ClusterSet, Point
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.quadtree import QuadTree
+from deeplearning4j_tpu.clustering.sptree import SPTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+__all__ = [
+    "Cluster",
+    "ClusterSet",
+    "Point",
+    "KMeansClustering",
+    "KDTree",
+    "QuadTree",
+    "SPTree",
+    "VPTree",
+]

@@ -1,0 +1,32 @@
+"""QuadTree: 2-D space-partitioning tree.
+
+Capability mirror of the reference clustering/quadtree/QuadTree.java (the
+2-D specialization used by the original Barnes-Hut t-SNE): NW/NE/SW/SE
+subdivision, center-of-mass cells, theta-criterion non-edge forces. Kept as
+the 2-D API twin of SPTree (which generalizes to any d)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SPTree
+
+
+class QuadTree(SPTree):
+    """2-D SPTree with the reference QuadTree construction surface."""
+
+    def __init__(self, center=None, width=None):
+        if center is None:
+            center = np.zeros(2)
+        if width is None:
+            width = np.ones(2)
+        assert len(center) == 2, "QuadTree is strictly 2-D"
+        super().__init__(center, width)
+
+    @classmethod
+    def build(cls, data: np.ndarray) -> "QuadTree":
+        data = np.asarray(data, np.float64)
+        assert data.shape[1] == 2, "QuadTree requires 2-D data"
+        return super().build(data)

@@ -1,0 +1,29 @@
+"""Graph embeddings — capability surface of deeplearning4j-graph
+(SURVEY.md section 2.4): Graph/IGraph adjacency structures, edge/vertex
+loaders, random-walk iterators, DeepWalk (random walks + hierarchical-softmax
+skip-gram), GraphHuffman coding, graph-vector serialization."""
+
+from deeplearning4j_tpu.graph.api import Edge, Graph, Vertex
+from deeplearning4j_tpu.graph.loaders import (
+    load_delimited_edges,
+    load_weighted_edges,
+)
+from deeplearning4j_tpu.graph.walks import (
+    NoEdgeHandling,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, build_graph_huffman
+
+__all__ = [
+    "Edge",
+    "Graph",
+    "Vertex",
+    "load_delimited_edges",
+    "load_weighted_edges",
+    "NoEdgeHandling",
+    "RandomWalkIterator",
+    "WeightedRandomWalkIterator",
+    "DeepWalk",
+    "build_graph_huffman",
+]

@@ -1,0 +1,88 @@
+"""Graph data structures.
+
+Capability mirror of the reference deeplearning4j-graph api/graph packages
+(deeplearning4j-graph/.../graph/api/{IGraph,Vertex,Edge}.java and
+graph/graph/Graph.java): vertex objects with optional values, directed or
+undirected edges with weights, adjacency-list storage, degree queries, and
+random connected-vertex sampling (Graph.getRandomConnectedVertex, used by
+the walk iterators).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Generic, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+V = TypeVar("V")
+
+
+@dataclass
+class Vertex(Generic[V]):
+    """Reference api/Vertex.java: index + value."""
+
+    idx: int
+    value: Any = None
+
+
+@dataclass
+class Edge:
+    """Reference api/Edge.java: from/to + optional weight + directed flag."""
+
+    src: int
+    dst: int
+    weight: float = 1.0
+    directed: bool = False
+
+
+class Graph:
+    """Adjacency-list graph (reference graph/Graph.java)."""
+
+    def __init__(self, num_vertices: int, directed: bool = False,
+                 vertex_values: Optional[Sequence[Any]] = None):
+        self.directed = directed
+        self._vertices = [
+            Vertex(i, vertex_values[i] if vertex_values is not None else None)
+            for i in range(num_vertices)
+        ]
+        self._adj: List[List[Edge]] = [[] for _ in range(num_vertices)]
+
+    # -- construction -----------------------------------------------------
+    def add_edge(self, src: int, dst: int, weight: float = 1.0,
+                 directed: Optional[bool] = None) -> None:
+        directed = self.directed if directed is None else directed
+        e = Edge(src, dst, weight, directed)
+        self._adj[src].append(e)
+        if not directed and src != dst:
+            self._adj[dst].append(Edge(dst, src, weight, directed))
+
+    # -- queries (IGraph surface) -----------------------------------------
+    def num_vertices(self) -> int:
+        return len(self._vertices)
+
+    def get_vertex(self, idx: int) -> Vertex:
+        return self._vertices[idx]
+
+    def get_vertex_degree(self, idx: int) -> int:
+        return len(self._adj[idx])
+
+    def get_edges_out(self, idx: int) -> List[Edge]:
+        return list(self._adj[idx])
+
+    def get_connected_vertex_indices(self, idx: int) -> List[int]:
+        return [e.dst for e in self._adj[idx]]
+
+    def get_random_connected_vertex(self, idx: int, rng: np.random.Generator) -> int:
+        """Uniform neighbor choice (Graph.getRandomConnectedVertex)."""
+        nbrs = self._adj[idx]
+        if not nbrs:
+            raise NoEdgesException(f"vertex {idx} has no outgoing edges")
+        return nbrs[int(rng.integers(0, len(nbrs)))].dst
+
+    def degrees(self) -> np.ndarray:
+        return np.array([len(a) for a in self._adj], np.int64)
+
+
+class NoEdgesException(Exception):
+    """Reference exception/NoEdgesException.java."""

@@ -1,0 +1,207 @@
+"""DeepWalk: random walks + hierarchical-softmax skip-gram on vertices.
+
+Capability mirror of the reference
+(deeplearning4j-graph/.../graph/models/deepwalk/DeepWalk.java:37: initialize
+builds a Huffman tree over VERTEX DEGREES (initialize(int[]) — degree plays
+the word-frequency role), then walks are consumed as "sentences" and each
+(center, context) vertex pair does an HS skip-gram update through
+InMemoryGraphLookupTable.trainVertexPair; GraphHuffman.java for the coding;
+query surface GraphVectorsImpl: similarity/verticesNearest;
+GraphVectorSerializer for IO).
+
+TPU-native: walks are generated on host (numpy), all pairs batched, and the
+SAME jitted HS step as word2vec (`_skipgram_hs_step` — gathers + sigmoid +
+scatter-mean) trains vertex vectors. One XLA program instead of one thread
+per GraphWalkIterator.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.graph.api import Graph
+from deeplearning4j_tpu.graph.walks import (
+    NoEdgeHandling,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.nlp.huffman import build_huffman
+from deeplearning4j_tpu.nlp.vocab import VocabWord
+from deeplearning4j_tpu.nlp.word2vec import _pad_batch, _skipgram_hs_step
+
+
+def build_graph_huffman(degrees: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Huffman codes over vertex degrees (GraphHuffman.buildTree — degree ==
+    frequency). Returns (points, codes, mask) padded tensors INDEXED BY
+    VERTEX ID (the reference keeps codes in vertex order too)."""
+    n = len(degrees)
+    words = [VocabWord(word=str(i), count=max(1.0, float(degrees[i])), index=i)
+             for i in range(n)]
+    order = sorted(range(n), key=lambda i: (-words[i].count, i))
+    sorted_words = [words[i] for i in order]
+    build_huffman(sorted_words)
+    L = max(len(w.codes) for w in sorted_words)
+    points = np.zeros((n, L), np.int32)
+    codes = np.zeros((n, L), np.float32)
+    mask = np.zeros((n, L), np.float32)
+    for w in words:  # codes were attached in-place through sorted_words refs
+        l = len(w.codes)
+        points[w.index, :l] = w.points[:l]
+        codes[w.index, :l] = w.codes[:l]
+        mask[w.index, :l] = 1.0
+    return points, codes, mask
+
+
+class DeepWalk:
+    """Reference DeepWalk builder surface: vectorSize, windowSize,
+    learningRate, seed (DeepWalk.Builder)."""
+
+    def __init__(
+        self,
+        vector_size: int = 100,
+        window_size: int = 5,
+        learning_rate: float = 0.01,
+        seed: int = 12345,
+        batch_size: int = 2048,
+    ):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.batch_size = batch_size
+        self.num_vertices = 0
+        self.vertex_vectors: Optional[np.ndarray] = None  # syn0
+        self._syn1: Optional[np.ndarray] = None
+        self._huffman: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self._init_called = False
+
+    # -- init -------------------------------------------------------------
+    def initialize(self, graph_or_degrees) -> "DeepWalk":
+        """Build degree-based Huffman tree + lookup table
+        (DeepWalk.initialize :85-105)."""
+        degrees = (
+            graph_or_degrees.degrees()
+            if isinstance(graph_or_degrees, Graph)
+            else np.asarray(graph_or_degrees, np.int64)
+        )
+        n = len(degrees)
+        self.num_vertices = n
+        self._huffman = build_graph_huffman(degrees)
+        rng = np.random.default_rng(self.seed)
+        self.vertex_vectors = (
+            (rng.random((n, self.vector_size)) - 0.5) / self.vector_size
+        ).astype(np.float32)
+        self._syn1 = np.zeros((n, self.vector_size), np.float32)
+        self._init_called = True
+        return self
+
+    # -- training ---------------------------------------------------------
+    def fit(self, graph: Graph, walk_length: int = 40, epochs: int = 1,
+            weighted: bool = False) -> "DeepWalk":
+        """Generate walks (one per vertex per epoch) and train
+        (DeepWalk.fit(IGraph,int) :100-115 + fit(iteratorProvider))."""
+        if not self._init_called:
+            self.initialize(graph)
+        it_cls = WeightedRandomWalkIterator if weighted else RandomWalkIterator
+        for epoch in range(epochs):
+            walks = list(
+                it_cls(
+                    graph,
+                    walk_length,
+                    seed=self.seed + epoch,
+                    no_edge_handling=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+                )
+            )
+            self.fit_walks(walks)
+        return self
+
+    def fit_walks(self, walks: Sequence[np.ndarray]) -> "DeepWalk":
+        """Train on explicit walk sequences (DeepWalk.fit(GraphWalkIterator)
+        — each walk is a sentence; window pairs like word2vec skipGram)."""
+        if not self._init_called:
+            raise RuntimeError("DeepWalk not initialized (call initialize first)")
+        P, C, M = self._huffman
+        w = self.window_size
+        rng = np.random.default_rng(self.seed)
+        centers, contexts = [], []
+        for walk in walks:
+            n = len(walk)
+            for i in range(n):
+                lo, hi = max(0, i - w), min(n, i + w + 1)
+                for c in range(lo, hi):
+                    if c != i:
+                        centers.append(walk[i])
+                        contexts.append(walk[c])
+        if not centers:
+            return self
+        centers = np.asarray(centers, np.int32)
+        contexts = np.asarray(contexts, np.int32)
+        order = rng.permutation(len(centers))
+        centers, contexts = centers[order], contexts[order]
+
+        syn0 = jnp.asarray(self.vertex_vectors)
+        syn1 = jnp.asarray(self._syn1)
+        B = self.batch_size
+        for bi in range(-(-len(centers) // B)):
+            sl = slice(bi * B, (bi + 1) * B)
+            cen, cx = centers[sl], contexts[sl]
+            npad = len(cen)
+            cen, cx = _pad_batch(cen, B), _pad_batch(cx, B)
+            pad_live = (np.arange(B) < npad).astype(np.float32)
+            syn0, syn1 = _skipgram_hs_step(
+                syn0, syn1, jnp.asarray(cx),
+                jnp.asarray(P[cen]), jnp.asarray(C[cen]),
+                jnp.asarray(M[cen] * pad_live[:, None]),
+                jnp.float32(self.learning_rate),
+            )
+        self.vertex_vectors = np.asarray(syn0)
+        self._syn1 = np.asarray(syn1)
+        return self
+
+    # -- query surface (GraphVectorsImpl) ---------------------------------
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        return self.vertex_vectors[idx]
+
+    def similarity(self, v1: int, v2: int) -> float:
+        """Cosine similarity (GraphVectorsImpl.similarity)."""
+        a, b = self.vertex_vectors[v1], self.vertex_vectors[v2]
+        denom = float(np.linalg.norm(a) * np.linalg.norm(b)) or 1.0
+        return float(np.dot(a, b) / denom)
+
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
+        v = self.vertex_vectors[idx]
+        norms = np.linalg.norm(self.vertex_vectors, axis=1)
+        norms = np.where(norms == 0, 1.0, norms)
+        sims = self.vertex_vectors @ v / (norms * (np.linalg.norm(v) or 1.0))
+        order = [int(i) for i in np.argsort(-sims) if int(i) != idx]
+        return order[:top_n]
+
+    # -- IO (GraphVectorSerializer) ---------------------------------------
+    def save(self, path: str) -> None:
+        np.savez(
+            path,
+            vertex_vectors=self.vertex_vectors,
+            syn1=self._syn1,
+            points=self._huffman[0],
+            codes=self._huffman[1],
+            mask=self._huffman[2],
+            meta=np.array(
+                [self.vector_size, self.window_size, self.num_vertices], np.int64
+            ),
+            lr=np.array([self.learning_rate], np.float64),
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "DeepWalk":
+        data = np.load(path if path.endswith(".npz") else path + ".npz")
+        vs, ws, n = (int(x) for x in data["meta"])
+        dw = cls(vector_size=vs, window_size=ws, learning_rate=float(data["lr"][0]))
+        dw.num_vertices = n
+        dw.vertex_vectors = data["vertex_vectors"]
+        dw._syn1 = data["syn1"]
+        dw._huffman = (data["points"], data["codes"], data["mask"])
+        dw._init_called = True
+        return dw

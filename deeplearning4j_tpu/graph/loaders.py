@@ -1,0 +1,53 @@
+"""Graph loaders.
+
+Capability mirror of the reference data package
+(deeplearning4j-graph/.../graph/data/GraphLoader.java with
+DelimitedEdgeLineProcessor / WeightedEdgeLineProcessor /
+DelimitedVertexLoader): parse "src<delim>dst[<delim>weight]" edge-list
+files into Graph objects, skipping comment lines.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.graph.api import Graph
+
+
+def load_delimited_edges(
+    path: str,
+    num_vertices: int,
+    delimiter: str = ",",
+    directed: bool = False,
+    comment_prefix: str = "//",
+) -> Graph:
+    """GraphLoader.loadUndirectedGraphEdgeListFile equivalent."""
+    g = Graph(num_vertices, directed=directed)
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(comment_prefix):
+                continue
+            parts = line.split(delimiter)
+            g.add_edge(int(parts[0]), int(parts[1]))
+    return g
+
+
+def load_weighted_edges(
+    path: str,
+    num_vertices: int,
+    delimiter: str = ",",
+    directed: bool = False,
+    comment_prefix: str = "//",
+) -> Graph:
+    """GraphLoader.loadWeightedEdgeListFile equivalent (weight in col 3)."""
+    g = Graph(num_vertices, directed=directed)
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(comment_prefix):
+                continue
+            parts = line.split(delimiter)
+            w = float(parts[2]) if len(parts) > 2 else 1.0
+            g.add_edge(int(parts[0]), int(parts[1]), weight=w)
+    return g

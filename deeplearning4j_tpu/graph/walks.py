@@ -1,0 +1,78 @@
+"""Random-walk iterators over graphs.
+
+Capability mirror of the reference iterator package
+(deeplearning4j-graph/.../graph/iterator/RandomWalkIterator.java,
+WeightedRandomWalkIterator.java, api/NoEdgeHandling.java): fixed-length
+walks starting from each vertex in order, uniform or edge-weight-proportional
+neighbor transition, SELF_LOOP or EXCEPTION handling for dangling vertices.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.api import Graph, NoEdgesException
+
+
+class NoEdgeHandling(Enum):
+    SELF_LOOP_ON_DISCONNECTED = "self_loop"
+    EXCEPTION_ON_DISCONNECTED = "exception"
+
+
+class RandomWalkIterator:
+    """Uniform random walks of fixed length, one starting at each vertex
+    0..n-1 (RandomWalkIterator.java)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        walk_length: int,
+        seed: int = 12345,
+        no_edge_handling: NoEdgeHandling = NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+        first_vertex: int = 0,
+        last_vertex: Optional[int] = None,
+    ):
+        self.graph = graph
+        self.walk_length = walk_length
+        self.seed = seed
+        self.no_edge_handling = no_edge_handling
+        self.first_vertex = first_vertex
+        self.last_vertex = (
+            graph.num_vertices() if last_vertex is None else last_vertex
+        )
+
+    def _next_vertex(self, cur: int, rng: np.random.Generator) -> int:
+        if self.graph.get_vertex_degree(cur) == 0:
+            if self.no_edge_handling is NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED:
+                return cur
+            raise NoEdgesException(f"vertex {cur} has no edges mid-walk")
+        return self.graph.get_random_connected_vertex(cur, rng)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        for start in range(self.first_vertex, self.last_vertex):
+            walk = np.empty((self.walk_length + 1,), np.int32)
+            walk[0] = start
+            cur = start
+            for t in range(1, self.walk_length + 1):
+                cur = self._next_vertex(cur, rng)
+                walk[t] = cur
+            yield walk
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Transition probability proportional to edge weight
+    (WeightedRandomWalkIterator.java)."""
+
+    def _next_vertex(self, cur: int, rng: np.random.Generator) -> int:
+        edges = self.graph.get_edges_out(cur)
+        if not edges:
+            if self.no_edge_handling is NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED:
+                return cur
+            raise NoEdgesException(f"vertex {cur} has no edges mid-walk")
+        weights = np.array([e.weight for e in edges], np.float64)
+        probs = weights / weights.sum()
+        return edges[int(rng.choice(len(edges), p=probs))].dst

@@ -1,0 +1,7 @@
+"""Dimensionality-reduction / visualization models — capability surface of
+the reference plot package (SURVEY.md section 2.1 "plot": Tsne exact +
+BarnesHutTsne over SPTree, 2,336 LoC)."""
+
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

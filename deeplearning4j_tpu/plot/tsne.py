@@ -1,0 +1,246 @@
+"""t-SNE: exact (device-batched) and Barnes-Hut (SPTree-accelerated).
+
+Capability mirror of the reference plot package:
+  - Tsne / LegacyTsne (deeplearning4j-core/.../plot/Tsne.java — exact
+    pairwise t-SNE with perplexity binary search, early exaggeration,
+    momentum + per-parameter gains);
+  - BarnesHutTsne (plot/BarnesHutTsne.java:62, implements Model, uses
+    clustering/sptree/SpTree + VPTree input neighbors; theta-approximate
+    repulsive forces, O(N log N)).
+
+TPU-native split: the exact variant is ONE jitted XLA program per gradient
+step — (N,N) affinity matrices are MXU-friendly batched matmuls, so exact
+t-SNE on device is fast well past the N where the reference must switch to
+Barnes-Hut. The BH variant keeps the tree walk on host (irregular pointer
+chasing — a CPU workload, as in the reference) and exists for very large N.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.sptree import SPTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+def _binary_search_betas(d2: np.ndarray, perplexity: float, tol: float = 1e-5,
+                         max_iter: int = 50) -> np.ndarray:
+    """Per-row precision (beta) search so that each row's conditional
+    distribution has entropy log(perplexity) (Tsne.java hBeta/x2p loop).
+    Vectorized over all rows at once. d2: squared distances with the
+    diagonal (or self entry) set to large/excluded by the caller."""
+    n = d2.shape[0]
+    beta = np.ones(n)
+    beta_min = np.full(n, -np.inf)
+    beta_max = np.full(n, np.inf)
+    log_u = np.log(perplexity)
+    P = np.zeros_like(d2)
+    for _ in range(max_iter):
+        P = np.exp(-d2 * beta[:, None])
+        sum_p = np.maximum(P.sum(axis=1), 1e-12)
+        h = np.log(sum_p) + beta * (d2 * P).sum(axis=1) / sum_p
+        diff = h - log_u
+        done = np.abs(diff) < tol
+        if done.all():
+            break
+        too_high = diff > 0
+        upd = ~done & too_high
+        beta_min[upd] = beta[upd]
+        beta[upd] = np.where(
+            np.isinf(beta_max[upd]), beta[upd] * 2, (beta[upd] + beta_max[upd]) / 2
+        )
+        upd = ~done & ~too_high
+        beta_max[upd] = beta[upd]
+        beta[upd] = np.where(
+            np.isinf(beta_min[upd]), beta[upd] / 2, (beta[upd] + beta_min[upd]) / 2
+        )
+    return P / np.maximum(P.sum(axis=1, keepdims=True), 1e-12)
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2, 3))
+def _tsne_step(P, Y, velocity, gains, momentum, lr):
+    """One exact t-SNE gradient step with momentum + gains (Tsne.java
+    gradient + update; gains rule from the original implementation)."""
+    sum_y = jnp.sum(Y * Y, axis=1)
+    num = 1.0 / (
+        1.0 + sum_y[:, None] - 2.0 * Y @ Y.T + sum_y[None, :]
+    )  # (N,N) student-t kernel, unnormalized
+    num = num.at[jnp.diag_indices(Y.shape[0])].set(0.0)
+    Q = jnp.maximum(num / jnp.sum(num), 1e-12)
+    PQ = (P - Q) * num  # (N,N)
+    grad = 4.0 * (
+        jnp.diag(PQ.sum(axis=1)) - PQ
+    ) @ Y  # (N,2): sum_j (p-q)q_un (y_i - y_j)
+    gains = jnp.where(
+        jnp.sign(grad) != jnp.sign(velocity),
+        gains + 0.2,
+        gains * 0.8,
+    )
+    gains = jnp.maximum(gains, 0.01)
+    velocity = momentum * velocity - lr * gains * grad
+    Y = Y + velocity
+    Y = Y - jnp.mean(Y, axis=0, keepdims=True)
+    kl = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12) / Q))
+    return Y, velocity, gains, kl
+
+
+class Tsne:
+    """Exact t-SNE (reference Tsne.Builder surface: maxIter, perplexity,
+    theta unused here, learningRate, useAdaGrad→gains)."""
+
+    def __init__(
+        self,
+        n_components: int = 2,
+        perplexity: float = 30.0,
+        max_iter: int = 1000,
+        learning_rate: float = 200.0,
+        early_exaggeration: float = 4.0,
+        exaggeration_iters: int = 100,
+        initial_momentum: float = 0.5,
+        final_momentum: float = 0.8,
+        momentum_switch: int = 250,
+        seed: int = 42,
+    ):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.max_iter = max_iter
+        self.learning_rate = learning_rate
+        self.early_exaggeration = early_exaggeration
+        self.exaggeration_iters = exaggeration_iters
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.momentum_switch = momentum_switch
+        self.seed = seed
+        self.kl_history: list = []
+        self.Y_: Optional[np.ndarray] = None
+
+    def _input_p(self, x: np.ndarray) -> np.ndarray:
+        d2 = (
+            np.sum(x * x, 1)[:, None] - 2.0 * x @ x.T + np.sum(x * x, 1)[None, :]
+        )
+        np.fill_diagonal(d2, 1e12)  # exclude self
+        p_cond = _binary_search_betas(np.maximum(d2, 0.0), self.perplexity)
+        P = (p_cond + p_cond.T) / (2.0 * p_cond.shape[0])
+        return np.maximum(P, 1e-12)
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        P = self._input_p(x)
+        rng = np.random.default_rng(self.seed)
+        Y = jnp.asarray(rng.normal(0, 1e-4, (n, self.n_components)).astype(np.float32))
+        vel = jnp.zeros_like(Y)
+        gains = jnp.ones_like(Y)
+        P_ex = jnp.asarray((P * self.early_exaggeration).astype(np.float32))
+        P_d = jnp.asarray(P.astype(np.float32))
+        self.kl_history = []
+        for it in range(self.max_iter):
+            momentum = (
+                self.initial_momentum
+                if it < self.momentum_switch
+                else self.final_momentum
+            )
+            p_use = P_ex if it < self.exaggeration_iters else P_d
+            Y, vel, gains, kl = _tsne_step(
+                p_use, Y, vel, gains,
+                jnp.float32(momentum), jnp.float32(self.learning_rate),
+            )
+            if it % 50 == 0 or it == self.max_iter - 1:
+                self.kl_history.append(float(kl))
+        self.Y_ = np.asarray(Y)
+        return self.Y_
+
+    # reference Tsne exposes plot(X, nDims, labels) saving coords; parity alias
+    def plot(self, x) -> np.ndarray:
+        return self.fit_transform(x)
+
+
+class BarnesHutTsne(Tsne):
+    """Barnes-Hut t-SNE (reference BarnesHutTsne.java: VPTree kNN input
+    similarities, SPTree theta-approximate repulsion)."""
+
+    def __init__(self, theta: float = 0.5, **kwargs):
+        kwargs.setdefault("early_exaggeration", 12.0)
+        super().__init__(**kwargs)
+        self.theta = theta
+
+    def _sparse_input_p(self, x: np.ndarray):
+        """Row-conditional P over 3*perplexity exact VPTree neighbors
+        (BarnesHutTsne.computeGaussianPerplexity)."""
+        n = x.shape[0]
+        k = min(n - 1, int(3 * self.perplexity))
+        tree = VPTree(x)
+        rows = np.zeros((n, k), np.int64)
+        d2 = np.zeros((n, k))
+        for i in range(n):
+            res = [r for r in tree.knn(x[i], k + 1) if r[1] != i][:k]
+            rows[i] = [r[1] for r in res]
+            d2[i] = [r[0] ** 2 for r in res]
+        p_cond = _binary_search_betas(d2, min(self.perplexity, k / 3.0))
+        # symmetrize sparse: P_ij = (p_j|i + p_i|j) / 2n over union support
+        P = {}
+        for i in range(n):
+            for jj in range(k):
+                j = int(rows[i, jj])
+                v = p_cond[i, jj] / (2.0 * n)
+                P[(i, j)] = P.get((i, j), 0.0) + v
+                P[(j, i)] = P.get((j, i), 0.0) + v
+        idx = np.array(list(P.keys()), np.int64)
+        vals = np.array(list(P.values()))
+        vals /= max(vals.sum(), 1e-12)
+        return idx, np.maximum(vals, 1e-12)
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        idx, pvals = self._sparse_input_p(x)
+        rng = np.random.default_rng(self.seed)
+        Y = rng.normal(0, 1e-4, (n, self.n_components))
+        vel = np.zeros_like(Y)
+        gains = np.ones_like(Y)
+        self.kl_history = []
+        for it in range(self.max_iter):
+            momentum = (
+                self.initial_momentum
+                if it < self.momentum_switch
+                else self.final_momentum
+            )
+            exaggeration = (
+                self.early_exaggeration if it < self.exaggeration_iters else 1.0
+            )
+            # attractive (edge) forces over sparse P
+            diff = Y[idx[:, 0]] - Y[idx[:, 1]]
+            qu = 1.0 / (1.0 + np.sum(diff * diff, axis=1))
+            coef = (exaggeration * pvals * qu)[:, None] * diff
+            pos_f = np.zeros_like(Y)
+            np.add.at(pos_f, idx[:, 0], coef)
+            # repulsive via SPTree
+            tree = SPTree.build(Y)
+            neg_f = np.zeros_like(Y)
+            sum_q = 0.0
+            for i in range(n):
+                f = np.zeros(self.n_components)
+                sum_q += tree.compute_non_edge_forces(Y[i], self.theta, f)
+                neg_f[i] = f
+            # same factor-4 scaling as the exact _tsne_step so learning_rate
+            # means the same thing in both variants
+            grad = 4.0 * (pos_f - neg_f / max(sum_q, 1e-12))
+            gains = np.where(
+                np.sign(grad) != np.sign(vel), gains + 0.2, gains * 0.8
+            )
+            gains = np.maximum(gains, 0.01)
+            vel = momentum * vel - self.learning_rate * gains * grad
+            Y = Y + vel
+            Y = Y - Y.mean(axis=0, keepdims=True)
+            if it % 50 == 0 or it == self.max_iter - 1:
+                diffq = Y[idx[:, 0]] - Y[idx[:, 1]]
+                qn = (1.0 / (1.0 + np.sum(diffq**2, 1))) / max(sum_q, 1e-12)
+                kl = float(np.sum(pvals * np.log(pvals / np.maximum(qn, 1e-12))))
+                self.kl_history.append(kl)
+        self.Y_ = Y
+        return self.Y_

@@ -1,0 +1,162 @@
+"""Clustering + spatial tree + t-SNE tests — mirrors the reference's
+clustering tests (KMeansTest, KDTreeTest, VPTreeTest, QuadTreeTest,
+SpTreeTest) and plot tests (TsneTest, BarnesHutTsneTest: KL decreases,
+clusters separate)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KDTree,
+    KMeansClustering,
+    Point,
+    QuadTree,
+    SPTree,
+    VPTree,
+)
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def blobs(n_per=30, centers=((0, 0), (10, 10), (-10, 10)), d=2, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    xs, ys = [], []
+    for ci, c in enumerate(centers):
+        pts = rng.normal(0, scale, (n_per, d)) + np.asarray(c)[None, :d]
+        xs.append(pts)
+        ys.extend([ci] * n_per)
+    return np.concatenate(xs).astype(np.float32), np.array(ys)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, y = blobs()
+        km = KMeansClustering.setup(3, 50, "euclidean", seed=1)
+        cs = km.apply_to(x)
+        assert len(cs) == 3
+        # each cluster should be label-pure
+        for c in cs.clusters:
+            labels = [y[int(p.point_id)] for p in c.points]
+            assert len(set(labels)) == 1
+        assert km.iterations_run <= 50
+
+    def test_point_objects_and_predict(self):
+        x, _ = blobs(n_per=10)
+        pts = [Point(row, point_id=str(i)) for i, row in enumerate(x)]
+        km = KMeansClustering(3, 30, seed=2)
+        km.apply_to(pts)
+        pred = km.predict(x[:5])
+        assert pred.shape == (5,)
+
+    def test_cosine_distance(self):
+        x, _ = blobs(n_per=10)
+        km = KMeansClustering(3, 20, distance="cosine", seed=0)
+        cs = km.apply_to(np.abs(x) + 0.1)
+        assert len(cs) == 3
+
+
+class TestKDTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.default_rng(3)
+        pts = rng.normal(size=(200, 5))
+        tree = KDTree.build(pts)
+        q = rng.normal(size=(5,))
+        res = tree.knn(q, 7)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:7]
+        assert [i for _, i in res] == list(brute)
+
+    def test_insert_and_nn(self):
+        tree = KDTree(2)
+        for i, p in enumerate([(0, 0), (5, 5), (1, 1), (9, 0)]):
+            tree.insert(np.array(p, float), i)
+        d, i = tree.nn(np.array([1.2, 1.1]))
+        assert i == 2
+
+    def test_range_query(self):
+        pts = np.array([[0, 0], [1, 1], [2, 2], [5, 5]], float)
+        tree = KDTree.build(pts)
+        inside = tree.range([0.5, 0.5], [2.5, 2.5])
+        assert sorted(inside) == [1, 2]
+
+
+class TestVPTree:
+    def test_knn_matches_bruteforce(self):
+        rng = np.random.default_rng(4)
+        pts = rng.normal(size=(150, 8))
+        tree = VPTree(pts)
+        q = rng.normal(size=(8,))
+        res = tree.knn(q, 5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert [i for _, i in res] == list(brute)
+
+    def test_cosine_neighbors(self):
+        pts = np.array([[1, 0], [0.9, 0.1], [0, 1], [-1, 0]], float)
+        tree = VPTree(pts, distance="cosine")
+        near = tree.words_nearest(np.array([1.0, 0.05]), 2)
+        assert set(near) == {0, 1}
+
+
+class TestSpatialTrees:
+    def test_sptree_com_and_count(self):
+        pts = np.array([[0, 0], [2, 0], [0, 2], [2, 2]], float)
+        tree = SPTree.build(pts)
+        assert tree.cum_size == 4
+        np.testing.assert_allclose(tree.center_of_mass, [1, 1])
+
+    def test_sptree_duplicate_points_no_recursion(self):
+        pts = np.array([[1.0, 1.0]] * 10)
+        tree = SPTree.build(pts)  # must not infinitely subdivide
+        assert tree.cum_size == 10
+
+    def test_bh_force_approximates_exact(self):
+        rng = np.random.default_rng(5)
+        pts = rng.normal(size=(100, 2))
+        tree = SPTree.build(pts)
+        q = pts[0]
+        # exact repulsive force
+        diff = q - pts[1:]
+        d2 = np.sum(diff * diff, axis=1)
+        qk = 1.0 / (1.0 + d2)
+        exact_f = np.sum((qk * qk)[:, None] * diff, axis=0)
+        exact_sq = qk.sum()
+        f = np.zeros(2)
+        sq = tree.compute_non_edge_forces(q, 0.3, f)
+        np.testing.assert_allclose(f, exact_f, rtol=0.1, atol=1e-3)
+        assert abs(sq - exact_sq) / exact_sq < 0.1
+
+    def test_quadtree_is_2d(self):
+        pts = np.random.default_rng(0).normal(size=(20, 2))
+        tree = QuadTree.build(pts)
+        assert tree.cum_size == 20
+        with pytest.raises(AssertionError):
+            QuadTree.build(np.zeros((5, 3)))
+
+
+class TestTsne:
+    def test_exact_tsne_separates_blobs_and_kl_decreases(self):
+        x, y = blobs(n_per=25, d=8, centers=((0,) * 8, (8,) * 8, (-8, 8) * 4),
+                     seed=1)
+        ts = Tsne(perplexity=10, max_iter=300, learning_rate=100, seed=0)
+        Y = ts.fit_transform(x)
+        assert Y.shape == (75, 2)
+        assert ts.kl_history[-1] < ts.kl_history[0]
+        # cluster separation: mean intra-class dist < mean inter-class dist
+        intra, inter = [], []
+        for i in range(0, 75, 5):
+            for j in range(0, 75, 7):
+                d = np.linalg.norm(Y[i] - Y[j])
+                (intra if y[i] == y[j] else inter).append(d)
+        assert np.mean(intra) < np.mean(inter)
+
+    def test_barnes_hut_tsne(self):
+        x, y = blobs(n_per=20, d=5, centers=((0,) * 5, (10,) * 5), seed=2)
+        ts = BarnesHutTsne(theta=0.5, perplexity=8, max_iter=150,
+                           learning_rate=100, seed=0)
+        Y = ts.fit_transform(x)
+        assert Y.shape == (40, 2)
+        assert np.isfinite(Y).all()
+        intra, inter = [], []
+        for i in range(40):
+            for j in range(i + 1, 40):
+                d = np.linalg.norm(Y[i] - Y[j])
+                (intra if y[i] == y[j] else inter).append(d)
+        assert np.mean(intra) < np.mean(inter)

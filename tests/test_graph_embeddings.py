@@ -1,0 +1,179 @@
+"""Graph-embedding tests — mirrors the reference deeplearning4j-graph test
+strategy: graph construction/loaders, walk iterators (TestGraph,
+TestRandomWalkIterator), DeepWalk end-to-end on a community graph, and the
+HS gradient check (DeepWalkGradientCheck.java)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Graph,
+    NoEdgeHandling,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+    build_graph_huffman,
+    load_delimited_edges,
+)
+from deeplearning4j_tpu.graph.api import NoEdgesException
+from deeplearning4j_tpu.nlp.word2vec import _skipgram_hs_step
+
+
+def two_communities(n_per=8, p_in=1.0, seed=0):
+    """Two dense cliques joined by a single bridge edge."""
+    g = Graph(2 * n_per)
+    for base in (0, n_per):
+        for i in range(n_per):
+            for j in range(i + 1, n_per):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, n_per)  # bridge
+    return g
+
+
+class TestGraphStructure:
+    def test_adjacency_and_degree(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2)
+        assert g.get_vertex_degree(1) == 2  # undirected: 0 and 2
+        assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+
+    def test_directed(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 0
+
+    def test_loader(self, tmp_path):
+        p = tmp_path / "edges.csv"
+        p.write_text("// comment\n0,1\n1,2\n2,0\n")
+        g = load_delimited_edges(str(p), 3)
+        assert g.get_vertex_degree(0) == 2
+
+
+class TestWalks:
+    def test_walk_length_and_connectivity(self):
+        g = two_communities()
+        walks = list(RandomWalkIterator(g, walk_length=10, seed=1))
+        assert len(walks) == g.num_vertices()
+        for w in walks:
+            assert len(w) == 11
+            for a, b in zip(w[:-1], w[1:]):
+                assert b in g.get_connected_vertex_indices(a) or a == b
+
+    def test_self_loop_on_disconnected(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        # vertex 2 is isolated
+        walks = list(RandomWalkIterator(g, walk_length=5, seed=1))
+        assert all(v == 2 for v in walks[2])
+
+    def test_exception_on_disconnected(self):
+        g = Graph(2)  # no edges at all
+        it = RandomWalkIterator(
+            g, walk_length=3,
+            no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED,
+        )
+        with pytest.raises(NoEdgesException):
+            list(it)
+
+    def test_weighted_walk_follows_heavy_edge(self):
+        g = Graph(3, directed=True)
+        g.add_edge(0, 1, weight=1000.0)
+        g.add_edge(0, 2, weight=0.001)
+        it = WeightedRandomWalkIterator(g, walk_length=1, seed=3,
+                                        first_vertex=0, last_vertex=1)
+        hits = [next(iter(WeightedRandomWalkIterator(
+            g, walk_length=1, seed=s, first_vertex=0, last_vertex=1)))[1]
+            for s in range(20)]
+        assert hits.count(1) >= 19  # overwhelmingly the heavy edge
+
+
+class TestGraphHuffman:
+    def test_codes_prefix_free_and_in_range(self):
+        degrees = np.array([10, 5, 5, 3, 2, 1])
+        P, C, M = build_graph_huffman(degrees)
+        n = len(degrees)
+        assert P.shape[0] == n
+        codes = []
+        for i in range(n):
+            l = int(M[i].sum())
+            assert l > 0
+            codes.append("".join(str(int(c)) for c in C[i, :l]))
+            assert (P[i, :l] >= 0).all() and (P[i, :l] <= n - 2).all()
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+
+
+class TestDeepWalkGradient:
+    def test_hs_step_matches_autodiff_gradient(self):
+        """DeepWalkGradientCheck analog: one (center, context) HS update must
+        equal one step of gradient DESCENT on the HS loss
+        sum_l -log sigmoid((1-2*code_l) * syn0[ctx]@syn1[point_l])."""
+        rng = np.random.default_rng(0)
+        n, d = 6, 4
+        P, C, M = build_graph_huffman(np.array([5, 4, 3, 2, 2, 1]))
+        syn0 = rng.normal(0, 0.1, (n, d)).astype(np.float32)
+        syn1 = rng.normal(0, 0.1, (n - 1, d)).astype(np.float32)
+        # pad syn1 to n rows like DeepWalk does (points < n-1 used only)
+        syn1 = np.concatenate([syn1, np.zeros((1, d), np.float32)])
+        center, ctx = 2, 4
+        L = P.shape[1]
+        l = int(M[center].sum())
+
+        def hs_loss(s0, s1):
+            tot = 0.0
+            for k in range(l):
+                dot = s0[ctx] @ s1[P[center, k]]
+                sign = 1.0 - 2.0 * C[center, k]
+                tot = tot - jax.nn.log_sigmoid(sign * dot)
+            return tot
+
+        g0, g1 = jax.grad(hs_loss, argnums=(0, 1))(jnp.asarray(syn0), jnp.asarray(syn1))
+        alpha = 0.05
+        out0, out1 = _skipgram_hs_step(
+            jnp.asarray(syn0), jnp.asarray(syn1),
+            jnp.asarray(np.array([ctx], np.int32)),
+            jnp.asarray(P[[center]]), jnp.asarray(C[[center]]),
+            jnp.asarray(M[[center]]), jnp.float32(alpha),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out0), syn0 - alpha * np.asarray(g0), rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(out1), syn1 - alpha * np.asarray(g1), rtol=1e-4, atol=1e-6
+        )
+
+
+class TestDeepWalkEndToEnd:
+    def test_communities_cluster(self):
+        g = two_communities(n_per=8)
+        dw = DeepWalk(vector_size=16, window_size=4, learning_rate=0.05, seed=1)
+        dw.fit(g, walk_length=20, epochs=8)
+        in_sims, out_sims = [], []
+        for i in range(1, 8):
+            in_sims.append(dw.similarity(1, i + 0) if i != 1 else 1.0)
+            out_sims.append(dw.similarity(1, 8 + i))
+        assert np.mean(in_sims) > np.mean(out_sims)
+
+    def test_nearest_within_community(self):
+        g = two_communities(n_per=8)
+        dw = DeepWalk(vector_size=16, window_size=4, learning_rate=0.05, seed=2)
+        dw.fit(g, walk_length=20, epochs=8)
+        near = dw.vertices_nearest(3, top_n=5)
+        in_community = sum(1 for v in near if v < 8)
+        assert in_community >= 3
+
+    def test_save_load_roundtrip(self, tmp_path):
+        g = two_communities(n_per=4)
+        dw = DeepWalk(vector_size=8, window_size=2, seed=3)
+        dw.fit(g, walk_length=8, epochs=1)
+        path = str(tmp_path / "deepwalk.npz")
+        dw.save(path)
+        dw2 = DeepWalk.load(path)
+        np.testing.assert_allclose(dw2.vertex_vectors, dw.vertex_vectors)
+        assert dw2.vector_size == 8 and dw2.num_vertices == 8
