@@ -33,19 +33,33 @@ class VPTree:
         self.items = np.asarray(items, np.float64)
         self.distance = distance
         if distance == "cosine":
+            # Tau pruning requires the triangle inequality, which cosine
+            # distance violates. On L2-NORMALIZED vectors, euclidean distance
+            # is monotone in cosine distance (||a-b||^2 = 2*(1 - a.b)), so we
+            # search in normalized-L2 space (metric) and report
+            # cos_dist = l2^2 / 2 — exact same ranking, valid pruning.
             norms = np.linalg.norm(self.items, axis=1, keepdims=True)
-            self._normed = self.items / np.maximum(norms, 1e-12)
+            self._search_items = self.items / np.maximum(norms, 1e-12)
+        else:
+            self._search_items = self.items
         self._rng = np.random.default_rng(seed)
         self.root = self._build(list(range(len(self.items))))
 
-    def _dist(self, i: int, q: np.ndarray) -> float:
+    def _prep_query(self, q: np.ndarray) -> np.ndarray:
         if self.distance == "cosine":
-            qn = q / max(float(np.linalg.norm(q)), 1e-12)
-            return float(1.0 - self._normed[i] @ qn)
-        return float(np.linalg.norm(self.items[i] - q))
+            return q / max(float(np.linalg.norm(q)), 1e-12)
+        return q
+
+    def _report(self, l2: float) -> float:
+        """Convert internal metric distance to the user-facing one."""
+        return l2 * l2 / 2.0 if self.distance == "cosine" else l2
+
+    def _dist(self, i: int, q: np.ndarray) -> float:
+        """Metric (triangle-inequality-valid) distance used for the search."""
+        return float(np.linalg.norm(self._search_items[i] - q))
 
     def _dist_ii(self, i: int, j: int) -> float:
-        return self._dist(i, self.items[j])
+        return self._dist(i, self._search_items[j])
 
     def _build(self, idxs: List[int]) -> Optional[_VPNode]:
         if not idxs:
@@ -66,7 +80,7 @@ class VPTree:
 
     def knn(self, query, k: int) -> List[Tuple[float, int]]:
         """k nearest (distance, index) pairs, ascending (VPTree.search)."""
-        query = np.asarray(query, np.float64)
+        query = self._prep_query(np.asarray(query, np.float64))
         heap: List[Tuple[float, int]] = []  # max-heap of (-d, idx)
         tau = [np.inf]
 
@@ -93,7 +107,7 @@ class VPTree:
                     rec(node.inside)
 
         rec(self.root)
-        return sorted([(-d, i) for d, i in heap])
+        return sorted([(self._report(-d), i) for d, i in heap])
 
     def words_nearest(self, query, k: int, exclude_self: bool = True) -> List[int]:
         res = self.knn(query, k + (1 if exclude_self else 0))

@@ -1,0 +1,129 @@
+"""Character-level LSTM language model — BASELINE configs[1].
+
+The reference-era canonical RNN workload (dl4j GravesLSTMCharModellingExample
+pattern over the reference runtime: nn/layers/recurrent/GravesLSTM.java +
+LSTMHelpers.java time loop; TBPTT MultiLayerNetwork.java:1162): stacked
+GravesLSTM layers + RnnOutputLayer(MCXENT over the character softmax),
+truncated BPTT, and rnnTimeStep-based sampling.
+
+TPU notes: the LSTM time loop is lax.scan inside ONE jitted train step;
+sampling streams through rnn_time_step carrying (h, c) state.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def char_rnn_conf(
+    vocab_size: int,
+    lstm_size: int = 200,
+    num_layers: int = 2,
+    seed: int = 12345,
+    learning_rate: float = 0.1,
+    updater: str = "rmsprop",
+    tbptt_length: int = 50,
+):
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .weight_init("xavier")
+        .list()
+    )
+    n_in = vocab_size
+    for i in range(num_layers):
+        b = b.layer(i, GravesLSTM(n_in=n_in, n_out=lstm_size, activation="tanh"))
+        n_in = lstm_size
+    b = b.layer(
+        num_layers,
+        RnnOutputLayer(
+            n_in=lstm_size, n_out=vocab_size, activation="softmax",
+            loss_function="mcxent",
+        ),
+    )
+    return (
+        b.backprop_type("truncated_bptt")
+        .t_bptt_forward_length(tbptt_length)
+        .t_bptt_backward_length(tbptt_length)
+        .build()
+    )
+
+
+class CharRnn:
+    """Train on raw text; generate with temperature sampling."""
+
+    def __init__(self, text: Optional[str] = None, chars: Optional[Sequence[str]] = None,
+                 **conf_kw):
+        if chars is None:
+            assert text is not None, "need text or explicit char list"
+            chars = sorted(set(text))
+        self.chars: List[str] = list(chars)
+        self.char_to_ix = {c: i for i, c in enumerate(self.chars)}
+        self.vocab_size = len(self.chars)
+        self.conf_kw = conf_kw
+        self.net = MultiLayerNetwork(char_rnn_conf(self.vocab_size, **conf_kw))
+        self.net.init(input_shape=(1, self.vocab_size))
+
+    # -- data -------------------------------------------------------------
+    def encode(self, text: str) -> np.ndarray:
+        return np.array([self.char_to_ix[c] for c in text if c in self.char_to_ix],
+                        np.int32)
+
+    def batches(self, text: str, batch: int, seq_len: int):
+        """Contiguous [B, T, V] one-hot minibatches with next-char labels
+        (CharacterIterator in the reference example)."""
+        ids = self.encode(text)
+        usable = (len(ids) - 1) // (batch * seq_len) * (batch * seq_len)
+        if usable <= 0:
+            raise ValueError("text too short for requested batch/seq_len")
+        xs = ids[:usable].reshape(batch, -1)
+        ys = ids[1 : usable + 1].reshape(batch, -1)
+        n_seq = xs.shape[1] // seq_len
+        eye = np.eye(self.vocab_size, dtype=np.float32)
+        for s in range(n_seq):
+            sl = slice(s * seq_len, (s + 1) * seq_len)
+            yield eye[xs[:, sl]], eye[ys[:, sl]]
+
+    # -- training ---------------------------------------------------------
+    def fit_text(self, text: str, epochs: int = 1, batch: int = 32,
+                 seq_len: int = 100) -> List[float]:
+        losses = []
+        for _ in range(epochs):
+            for x, y in self.batches(text, batch, seq_len):
+                losses.append(float(self.net.fit(x, y)))
+        return losses
+
+    # -- generation -------------------------------------------------------
+    def sample(self, prime: str, length: int = 200, temperature: float = 1.0,
+               seed: int = 0) -> str:
+        """Stream generation via rnn_time_step (reference
+        sampleCharactersFromNetwork pattern over rnnTimeStep :2152)."""
+        rng = np.random.default_rng(seed)
+        self.net.rnn_clear_previous_state()
+        eye = np.eye(self.vocab_size, dtype=np.float32)
+        known_prime = [c for c in prime if c in self.char_to_ix]
+        out = list(known_prime)
+        # no known prime chars: start from the uniform distribution
+        probs = np.full((1, self.vocab_size), 1.0 / self.vocab_size, np.float32)
+        for c in known_prime:
+            x = eye[self.char_to_ix[c]][None, None, :]
+            probs = np.asarray(self.net.rnn_time_step(x))[0]
+        for _ in range(length):
+            p = probs.reshape(-1).astype(np.float64)
+            if temperature != 1.0:
+                logp = np.log(np.maximum(p, 1e-12)) / temperature
+                p = np.exp(logp - logp.max())
+            p /= p.sum()
+            ci = int(rng.choice(self.vocab_size, p=p))
+            out.append(self.chars[ci])
+            x = eye[ci][None, None, :]
+            probs = np.asarray(self.net.rnn_time_step(x))[0]
+        return "".join(out)

@@ -1,0 +1,131 @@
+"""ResNet-50 on the ComputationGraph — BASELINE configs[2] flagship.
+
+Built through the graph config DSL the way a reference user would compose a
+residual net from ComputationGraphConfiguration.GraphBuilder with
+ElementWiseVertex(Op.Add) shortcuts (reference DAG machinery:
+deeplearning4j-core/.../nn/graph/ComputationGraph.java;
+vertex impls .../nn/graph/vertex/impl/ElementWiseVertex.java).
+
+TPU notes: every conv lowers to lax.conv_general_dilated (NHWC/HWIO) on the
+MXU; the whole forward+backward+update is ONE jitted XLA program. Bottleneck
+1x1/3x3/1x1 convs are exactly the shapes XLA tiles well; batch norm fuses
+into the surrounding convs at compile time.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graph import ElementWiseVertex
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    ConvolutionLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+# (num_blocks, mid_channels, out_channels) per stage
+_STAGES = [(3, 64, 256), (4, 128, 512), (6, 256, 1024), (3, 512, 2048)]
+
+
+def _conv_bn(gb, name, n_in, n_out, kernel, stride, padding, input_name,
+             activation=None):
+    gb.add_layer(
+        f"{name}_conv",
+        ConvolutionLayer(
+            n_in=n_in, n_out=n_out, kernel_size=kernel, stride=stride,
+            padding=padding, activation="identity", bias_init=0.0,
+        ),
+        input_name,
+    )
+    gb.add_layer(f"{name}_bn", BatchNormalization(n_in=n_out, n_out=n_out),
+                 f"{name}_conv")
+    last = f"{name}_bn"
+    if activation:
+        gb.add_layer(f"{name}_act", ActivationLayer(activation=activation), last)
+        last = f"{name}_act"
+    return last
+
+
+def _bottleneck(gb, name, n_in, mid, n_out, stride, input_name):
+    """1x1 -> 3x3 -> 1x1 bottleneck with identity/projection shortcut."""
+    a = _conv_bn(gb, f"{name}_a", n_in, mid, (1, 1), (stride, stride), (0, 0),
+                 input_name, activation="relu")
+    b = _conv_bn(gb, f"{name}_b", mid, mid, (3, 3), (1, 1), (1, 1), a,
+                 activation="relu")
+    c = _conv_bn(gb, f"{name}_c", mid, n_out, (1, 1), (1, 1), (0, 0), b)
+    if stride != 1 or n_in != n_out:
+        shortcut = _conv_bn(gb, f"{name}_proj", n_in, n_out, (1, 1),
+                            (stride, stride), (0, 0), input_name)
+    else:
+        shortcut = input_name
+    gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), c, shortcut)
+    gb.add_layer(f"{name}_out", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_out"
+
+
+def resnet50_conf(
+    num_classes: int = 1000,
+    input_size: int = 224,
+    in_channels: int = 3,
+    seed: int = 12345,
+    learning_rate: float = 0.1,
+    updater: str = "nesterovs",
+    momentum: float = 0.9,
+    l2: float = 1e-4,
+):
+    gb = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .momentum(momentum)
+        .l2(l2)
+        .weight_init("relu")  # He init, reference WeightInit.RELU
+        .graph_builder()
+        .add_inputs("in")
+    )
+    stem = _conv_bn(gb, "stem", in_channels, 64, (7, 7), (2, 2), (3, 3), "in",
+                    activation="relu")
+    gb.add_layer(
+        "stem_pool",
+        SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                         padding=(1, 1)),
+        stem,
+    )
+    cur = "stem_pool"
+    n_in = 64
+    for si, (blocks, mid, n_out) in enumerate(_STAGES):
+        for bi in range(blocks):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            cur = _bottleneck(gb, f"s{si}b{bi}", n_in, mid, n_out, stride, cur)
+            n_in = n_out
+    # 5 ceil-halving downsamples: stem conv (k7 s2 p3), stem maxpool
+    # (k3 s2 p1), and the first block of stages 1-3 — each maps h -> ceil(h/2)
+    final_hw = input_size
+    for _ in range(5):
+        final_hw = (final_hw + 1) // 2
+    final_hw = max(1, final_hw)
+    gb.add_layer(
+        "avgpool",
+        SubsamplingLayer(pooling_type="avg", kernel_size=(final_hw, final_hw),
+                         stride=(final_hw, final_hw)),
+        cur,
+    )
+    gb.add_layer(
+        "out",
+        OutputLayer(n_in=n_in, n_out=num_classes, activation="softmax",
+                    loss_function="mcxent"),
+        "avgpool",
+        preprocessor=CnnToFeedForwardPreProcessor(1, 1, n_in),
+    )
+    return gb.set_outputs("out").build()
+
+
+def build_resnet50(input_size: int = 224, num_classes: int = 1000, **kw) -> ComputationGraph:
+    conf = resnet50_conf(num_classes=num_classes, input_size=input_size, **kw)
+    net = ComputationGraph(conf)
+    net.init(input_shapes={"in": (input_size, input_size, 3)})
+    return net

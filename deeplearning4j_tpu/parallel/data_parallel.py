@@ -63,15 +63,16 @@ class ParallelWrapper:
         self._placed = True
 
     def fit(self, features, labels, mask=None, label_mask=None) -> float:
-        """One data-parallel train step across the mesh."""
+        """One data-parallel train step across the mesh. Accepts either a
+        MultiLayerNetwork (array features/labels) or a ComputationGraph
+        (array-or-list features/labels) — the same duality as the reference's
+        ParallelWrapper, which wraps Model (MLN or CG)."""
         self._place_model()
-        b = np.asarray(features).shape[0]
-        if b % self.n != 0:
-            raise ValueError(
-                f"batch {b} not divisible by {self.n} devices "
-                "(pad or trim — static shapes keep the step compiled once)"
-            )
         net = self.net
+        if hasattr(net, "_as_inputs"):  # ComputationGraph
+            return self._fit_graph(features, labels, mask, label_mask)
+        b = np.asarray(features).shape[0]
+        self._check_divisible(b)
         x = jax.device_put(jnp.asarray(features), self.data_sharding)
         y = jax.device_put(jnp.asarray(labels), self.data_sharding)
         m = None if mask is None else jax.device_put(jnp.asarray(mask), self.data_sharding)
@@ -81,6 +82,54 @@ class ParallelWrapper:
         net.params, net.states, net.updater_state, loss = step(
             net.params, net.states, net.updater_state, x, y,
             jnp.asarray(net.iteration, jnp.int32), srng, m, lm,
+        )
+        net._record_iteration(loss)
+        return loss
+
+    def _check_divisible(self, b: int) -> None:
+        if b % self.n != 0:
+            raise ValueError(
+                f"batch {b} not divisible by {self.n} devices "
+                "(pad or trim — static shapes keep the step compiled once)"
+            )
+
+    def _fit_graph(self, features, labels, masks=None, label_masks=None) -> float:
+        from deeplearning4j_tpu.nn.graph import _as_list
+
+        net = self.net
+        if net.conf.backprop_type == "truncated_bptt":
+            raise NotImplementedError(
+                "ParallelWrapper does not yet shard truncated-BPTT graph "
+                "training; use net.fit per window or standard backprop"
+            )
+        if net.conf.optimization_algo != "stochastic_gradient_descent":
+            raise NotImplementedError(
+                "ParallelWrapper shards the SGD train step; "
+                f"optimization_algo={net.conf.optimization_algo!r} requires "
+                "the serial Solver path (net.fit)"
+            )
+        inputs = net._as_inputs(features)
+        labels_l = [jnp.asarray(l) for l in _as_list(labels)]
+        if len(labels_l) != len(net.conf.outputs):
+            raise ValueError(
+                f"expected {len(net.conf.outputs)} label arrays, got {len(labels_l)}"
+            )
+        self._check_divisible(next(iter(inputs.values())).shape[0])
+        put = lambda t: jax.device_put(t, self.data_sharding)
+        inputs = {k: put(v) for k, v in inputs.items()}
+        labels_l = [put(l) for l in labels_l]
+        masks_d = net._as_masks(masks)
+        masks_d = {k: put(v) for k, v in masks_d.items()}
+        lmasks = (
+            [None if m is None else put(jnp.asarray(m)) for m in label_masks]
+            if label_masks is not None
+            else None
+        )
+        step = net._get_train_step(len(labels_l), lmasks is not None)
+        srng = rng_mod.step_key(net._rng, net.iteration)
+        net.params, net.states, net.updater_state, loss = step(
+            net.params, net.states, net.updater_state, inputs, labels_l,
+            jnp.asarray(net.iteration, jnp.int32), srng, masks_d, lmasks,
         )
         net._record_iteration(loss)
         return loss

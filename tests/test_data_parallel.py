@@ -122,5 +122,5 @@ def test_graft_entry_dryrun():
 
     fn, args = ge.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape == (8, 10)
+    assert out.shape == (8, 1000)  # ResNet-50 flagship
     ge.dryrun_multichip(8)
