@@ -1,0 +1,51 @@
+"""Distributed early stopping.
+
+Capability mirror of the reference SparkEarlyStoppingTrainer /
+SparkEarlyStoppingGraphTrainer (dl4j-spark/.../spark/earlystopping/): the
+epoch loop, terminations, scoring and best-model saving are identical to the
+local trainer, but each epoch's fitting is delegated to a TrainingMaster
+round (one full pass of parameter-averaged distributed training) instead of
+serial minibatch fits."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
+from deeplearning4j_tpu.earlystopping.result import EarlyStoppingResult
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+
+class DistributedEarlyStoppingTrainer(EarlyStoppingTrainer):
+    def __init__(
+        self,
+        config: EarlyStoppingConfiguration,
+        training_master: TrainingMaster,
+        net,
+        train_iterator,
+    ):
+        super().__init__(config, net, train_iterator)
+        self.training_master = training_master
+
+    def fit(self, max_epochs: int = 1_000_000) -> EarlyStoppingResult:
+        # Reuse the serial epoch loop but swap the per-epoch fit: one
+        # TrainingMaster round == one "epoch" (SparkEarlyStoppingTrainer
+        # semantics: each epoch is a full executeTraining over the RDD).
+        master = self.training_master
+        net = self.net
+        iterator = self.train_iterator
+
+        class _MasterEpochIterator:
+            """Adapter: iterating it performs the distributed round and
+            yields nothing (losses are tracked on the net), so the base
+            trainer's minibatch loop degenerates to one master call."""
+
+            def __iter__(self):
+                master.execute_training(net, iterator)
+                return iter(())
+
+            def reset(self):
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+
+        inner = EarlyStoppingTrainer(self.config, net, _MasterEpochIterator())
+        return inner.fit(max_epochs=max_epochs)

@@ -126,12 +126,14 @@ class ParallelWrapper:
             else None
         )
         step = net._get_train_step(len(labels_l), lmasks is not None)
-        srng = rng_mod.step_key(net._rng, net.iteration)
-        net.params, net.states, net.updater_state, loss = step(
-            net.params, net.states, net.updater_state, inputs, labels_l,
-            jnp.asarray(net.iteration, jnp.int32), srng, masks_d, lmasks,
-        )
-        net._record_iteration(loss)
+        loss = None
+        for _ in range(max(1, net.conf.iterations)):  # same loop as net.fit
+            srng = rng_mod.step_key(net._rng, net.iteration)
+            net.params, net.states, net.updater_state, loss = step(
+                net.params, net.states, net.updater_state, inputs, labels_l,
+                jnp.asarray(net.iteration, jnp.int32), srng, masks_d, lmasks,
+            )
+            net._record_iteration(loss)
         return loss
 
     def fit_iterator(self, iterator, num_epochs: int = 1):

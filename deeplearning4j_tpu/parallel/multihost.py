@@ -1,0 +1,100 @@
+"""Multi-host (multi-process) distributed runtime.
+
+Capability mirror of the reference's cluster communication layer (SURVEY.md
+section 2.7 "Communication backends": Spark RPC/broadcast as the data plane,
+ZooKeeper service discovery, NTP clock alignment). TPU-native equivalent:
+jax.distributed — one controller process per host, XLA collectives riding
+ICI within a slice and DCN across slices; discovery via the coordinator
+address (the ZooKeeper role), clocks by the host (stats.TimeSource).
+
+All helpers degrade gracefully to single-process: the same training code
+runs unchanged on 1 host (jax.devices() == local) or N hosts
+(jax.devices() == global). The driver validates the sharded program via
+__graft_entry__.dryrun_multichip on a virtual mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class MultiHostConfig:
+    """The coordinator triple (jax.distributed.initialize signature);
+    fields default from the standard env vars so launchers can inject them
+    (the ZooKeeperConfigurationRegister role — SURVEY.md section 2.4)."""
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+
+    @classmethod
+    def from_env(cls) -> "MultiHostConfig":
+        return cls(
+            coordinator_address=os.environ.get("DL4J_TPU_COORDINATOR"),
+            num_processes=_int_env("DL4J_TPU_NUM_PROCESSES"),
+            process_id=_int_env("DL4J_TPU_PROCESS_ID"),
+        )
+
+    def is_configured(self) -> bool:
+        return self.coordinator_address is not None
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+_initialized = False
+
+
+def initialize_multihost(config: Optional[MultiHostConfig] = None) -> bool:
+    """Bring up jax.distributed if a coordinator is configured; returns
+    whether multi-host mode is active. Safe to call multiple times and in
+    single-process runs (no-op)."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return True
+    config = config or MultiHostConfig.from_env()
+    if not config.is_configured():
+        return False
+    jax.distributed.initialize(
+        coordinator_address=config.coordinator_address,
+        num_processes=config.num_processes,
+        process_id=config.process_id,
+    )
+    _initialized = True
+    return True
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_info() -> dict:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """Each process feeds only its shard of the global batch
+    (jax.make_array_from_process_local_data pattern): process i gets the
+    i-th balanced contiguous slice."""
+    import jax
+
+    n, i = jax.process_count(), jax.process_index()
+    base, extra = divmod(global_batch, n)
+    start = i * base + min(i, extra)
+    return slice(start, start + base + (1 if i < extra else 0))

@@ -1,0 +1,258 @@
+"""Distributed-training control plane: TrainingMaster / TrainingWorker.
+
+Capability mirror of the reference Spark training contract (SURVEY.md
+sections 2.3 and 3.3):
+  - TrainingMaster/TrainingWorker pluggable contract
+    (dl4j-spark/.../spark/api/TrainingMaster.java:24-93, TrainingWorker.java)
+    with WorkerConfiguration and Repartition strategy;
+  - ParameterAveragingTrainingMaster
+    (.../impl/paramavg/ParameterAveragingTrainingMaster.java:47): splits the
+    incoming data so each split is numWorkers x batchSizePerWorker x
+    averagingFrequency examples (:148), runs workers, averages params (+
+    updater state), repeats; builder defaults batchSizePerWorker=16,
+    averagingFrequency=5 (:463-471);
+  - distributed evaluation (SparkDl4jMultiLayer.evaluate ->
+    EvaluateFlatMapFunction + EvaluationReduceFunction.java:18-19 merging
+    Evaluation objects);
+  - training stats collection per phase (stats.py).
+
+TPU-native mapping: "executors" are mesh devices. The data plane
+(broadcast params out / aggregate params in) becomes the
+ParameterAveragingTrainer's shard_map + pmean over ICI; this module is the
+HOST control plane — data splitting, retries, stats, evaluation merge —
+exactly the part of the reference that stays on the driver JVM.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.iterator import DataSet
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.parallel.data_parallel import (
+    ParallelWrapper,
+    ParameterAveragingTrainer,
+)
+from deeplearning4j_tpu.parallel.stats import TrainingStats
+
+
+@dataclass
+class WorkerConfiguration:
+    """Reference api/WorkerConfiguration.java."""
+
+    batch_size_per_worker: int = 16
+    averaging_frequency: int = 5
+    prefetch_num_batches: int = 2
+    collect_training_stats: bool = False
+
+
+class Repartition:
+    """Reference api/Repartition enum."""
+
+    ALWAYS = "always"
+    NEVER = "never"
+    NUM_PARTITIONS_WORKERS_DIFFERS = "num_partitions_workers_differs"
+
+
+def balanced_splits(n: int, k: int) -> List[slice]:
+    """Exact balanced partitioning (reference BalancedPartitioner +
+    AssignIndexFunction semantics): first n%k parts get one extra element."""
+    base, extra = divmod(n, k)
+    out, start = [], 0
+    for i in range(k):
+        size = base + (1 if i < extra else 0)
+        out.append(slice(start, start + size))
+        start += size
+    return out
+
+
+class TrainingMaster:
+    """Abstract contract (TrainingMaster.java): executeTraining + stats."""
+
+    def execute_training(self, net, iterator) -> None:
+        raise NotImplementedError
+
+    def get_training_stats(self) -> Optional[TrainingStats]:
+        return None
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Host control plane over the device-side ParameterAveragingTrainer."""
+
+    def __init__(
+        self,
+        num_workers: Optional[int] = None,
+        batch_size_per_worker: int = 16,
+        averaging_frequency: int = 5,
+        save_updater: bool = True,
+        repartition: str = Repartition.ALWAYS,
+        collect_training_stats: bool = False,
+        max_retries: int = 2,
+        rng_seed: int = 12345,
+    ):
+        import jax
+
+        self.num_workers = num_workers or len(jax.devices())
+        self.batch_size_per_worker = batch_size_per_worker
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.save_updater = save_updater
+        self.repartition = repartition
+        self.collect_training_stats = collect_training_stats
+        self.max_retries = max_retries
+        self.rng_seed = rng_seed
+        self.stats = TrainingStats() if collect_training_stats else None
+        self._trainer: Optional[ParameterAveragingTrainer] = None
+        self._trainer_net = None
+        self._round = 0
+
+    # -- data plane helpers -----------------------------------------------
+    def _examples_per_split(self) -> int:
+        # reference :148 — one split feeds every worker for `freq` minibatches
+        return self.num_workers * self.batch_size_per_worker * self.averaging_frequency
+
+    def _collect(self, iterator) -> List[DataSet]:
+        if isinstance(iterator, (list, tuple)):
+            return list(iterator)
+        out = list(iterator)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        return out
+
+    def _splits(self, datasets: List[DataSet]):
+        """Concatenate and re-split so each split is exactly
+        workers x batch x freq examples (repartition=Always; the reference's
+        Balanced repartition becomes an exact reshape here)."""
+        x = np.concatenate([np.asarray(d.features) for d in datasets])
+        y = np.concatenate([np.asarray(d.labels) for d in datasets])
+        if self.repartition == Repartition.ALWAYS:
+            # vary the shuffle per call (the reference repartitions each fit)
+            rng = np.random.default_rng(self.rng_seed + self._round)
+            self._round += 1
+            order = rng.permutation(len(x))
+            x, y = x[order], y[order]
+        per = self._examples_per_split()
+        n_full = len(x) // per
+        dropped = len(x) - n_full * per
+        if dropped:
+            # static shard_map shapes require whole averaging rounds; the
+            # shuffle rotates which examples land in the tail across rounds
+            logger.warning(
+                "parameter averaging: dropping %d tail examples "
+                "(< one %d-example round)", dropped, per,
+            )
+        for s in range(n_full):
+            sl = slice(s * per, (s + 1) * per)
+            yield x[sl], y[sl]
+
+    # -- TrainingMaster contract ------------------------------------------
+    def execute_training(self, net, iterator) -> None:
+        """fit(JavaRDD<DataSet>) analog (SparkDl4jMultiLayer.fit:194-230 →
+        executeTraining:163): per split, one averaging round on the mesh."""
+        if hasattr(net, "_as_inputs"):
+            raise NotImplementedError(
+                "ParameterAveragingTrainingMaster drives the shard_map "
+                "worker loop, which currently supports MultiLayerNetwork "
+                "only; wrap ComputationGraph training in ParallelWrapper "
+                "(gradient DP) instead"
+            )
+        if self._trainer is None or self._trainer_net is not net:
+            self._trainer = ParameterAveragingTrainer(
+                net,
+                num_workers=self.num_workers,
+                averaging_frequency=self.averaging_frequency,
+                save_updater=self.save_updater,
+            )
+            self._trainer_net = net
+        datasets = self._collect(iterator)
+        stats = self.stats
+        with stats.timed("split") if stats else contextlib.nullcontext():
+            splits = list(self._splits(datasets))
+        if not splits:
+            raise ValueError(
+                f"not enough examples for one averaging round "
+                f"(need {self._examples_per_split()})"
+            )
+        for x, y in splits:
+            attempt = 0
+            while True:
+                try:
+                    if stats:
+                        with stats.timed("fit", example_count=len(x)):
+                            self._trainer.fit(x, y)
+                    else:
+                        self._trainer.fit(x, y)
+                    break
+                except Exception:
+                    # Spark retries failed tasks natively (SURVEY.md section 5
+                    # failure detection); parameter averaging is idempotent
+                    # per split, so a bounded retry reproduces that behavior.
+                    attempt += 1
+                    if attempt > self.max_retries:
+                        raise
+
+    def get_training_stats(self) -> Optional[TrainingStats]:
+        return self.stats
+
+
+class DistributedEvaluator:
+    """Map-reduce evaluation (EvaluateFlatMapFunction +
+    EvaluationReduceFunction): evaluate shards independently, merge."""
+
+    def __init__(self, num_shards: Optional[int] = None):
+        import jax
+
+        self.num_shards = num_shards or len(jax.devices())
+
+    def evaluate(self, net, datasets: Iterable[DataSet]) -> Evaluation:
+        datasets = list(datasets)
+        shards = balanced_splits(len(datasets), self.num_shards)
+        partials: List[Evaluation] = []
+        for sl in shards:
+            ev = Evaluation()
+            for ds in datasets[sl]:
+                out = net.output(ds.features)
+                out0 = out[0] if isinstance(out, (list, tuple)) else out
+                ev.eval(np.asarray(ds.labels), np.asarray(out0),
+                        mask=ds.labels_mask)
+            partials.append(ev)
+        merged = partials[0]
+        for ev in partials[1:]:
+            merged.merge(ev)
+        return merged
+
+
+class SparkStyleNetwork:
+    """User-facing wrapper pairing a net with a TrainingMaster
+    (SparkDl4jMultiLayer role; for ComputationGraph use ParallelWrapper —
+    the averaging master's worker loop is MLN-only for now)."""
+
+    def __init__(self, net, training_master: TrainingMaster):
+        self.net = net
+        self.training_master = training_master
+
+    def fit(self, iterator_or_datasets) -> "SparkStyleNetwork":
+        self.training_master.execute_training(self.net, iterator_or_datasets)
+        return self
+
+    def evaluate(self, datasets) -> Evaluation:
+        return DistributedEvaluator().evaluate(self.net, datasets)
+
+    def score_examples(self, datasets) -> np.ndarray:
+        """Per-example scores (SparkDl4jMultiLayer.scoreExamples): one loss
+        value per example, concatenated over all datasets. Computed by
+        scoring batch-1 slices (one extra XLA compile at batch 1)."""
+        scores = []
+        for ds in datasets:
+            f = np.asarray(ds.features)
+            l = np.asarray(ds.labels)
+            for i in range(f.shape[0]):
+                scores.append(self.net.score(f[i : i + 1], l[i : i + 1]))
+        return np.asarray(scores)

@@ -1,0 +1,202 @@
+"""Distributed control-plane tests — mirrors the reference Spark test
+strategy (SURVEY.md section 4 "Distributed-without-a-cluster"): local-mode
+masters on the 8-device CPU mesh, stats collection
+(TestTrainingStatsCollection), repartitioning invariants
+(TestRepartitioning), distributed eval merge, distributed early stopping
+(TestEarlyStoppingSpark)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterator import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
+from deeplearning4j_tpu.earlystopping.distributed import (
+    DistributedEarlyStoppingTrainer,
+)
+from deeplearning4j_tpu.earlystopping.terminations import (
+    MaxEpochsTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.multihost import (
+    MultiHostConfig,
+    initialize_multihost,
+    local_batch_slice,
+    process_info,
+)
+from deeplearning4j_tpu.parallel.stats import (
+    NTPTimeSource,
+    SystemClockTimeSource,
+    TrainingStats,
+)
+from deeplearning4j_tpu.parallel.training_master import (
+    DistributedEvaluator,
+    ParameterAveragingTrainingMaster,
+    Repartition,
+    SparkStyleNetwork,
+    balanced_splits,
+)
+
+
+def small_net(seed=12345, lr=0.1):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(lr)
+        .updater("sgd")
+        .weight_init("xavier")
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=16, activation="tanh"))
+        .layer(1, OutputLayer(n_in=16, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def iris_like(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    # fixed labeling rule so train/val come from the same task
+    w = np.random.default_rng(42).normal(size=(4, 3))
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return x, y
+
+
+def datasets_of(n, batch, seed=0):
+    x, y = iris_like(n, seed)
+    return [DataSet(x[i : i + batch], y[i : i + batch])
+            for i in range(0, n, batch)]
+
+
+class TestBalancedSplits:
+    def test_exact_balance(self):
+        sls = balanced_splits(10, 3)
+        sizes = [s.stop - s.start for s in sls]
+        assert sizes == [4, 3, 3]
+        assert sls[-1].stop == 10
+
+    def test_more_workers_than_items(self):
+        sls = balanced_splits(2, 4)
+        assert [s.stop - s.start for s in sls] == [1, 1, 0, 0]
+
+
+class TestParameterAveragingMaster:
+    def test_training_reduces_score(self):
+        net = small_net()
+        master = ParameterAveragingTrainingMaster(
+            num_workers=4, batch_size_per_worker=8, averaging_frequency=2,
+        )
+        data = datasets_of(4 * 8 * 2 * 3, 32)
+        before = net.score(*iris_like(64, seed=9))
+        SparkStyleNetwork(net, master).fit(data)
+        after = net.score(*iris_like(64, seed=9))
+        assert after < before
+
+    def test_stats_collection(self):
+        net = small_net()
+        master = ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size_per_worker=8, averaging_frequency=2,
+            collect_training_stats=True,
+        )
+        master.execute_training(net, datasets_of(2 * 8 * 2 * 2, 16))
+        stats = master.get_training_stats()
+        summary = stats.summary()
+        assert "split" in summary and "fit" in summary
+        assert summary["fit"]["count"] == 2  # two averaging rounds
+
+    def test_insufficient_data_raises(self):
+        net = small_net()
+        master = ParameterAveragingTrainingMaster(
+            num_workers=8, batch_size_per_worker=16, averaging_frequency=5,
+        )
+        with pytest.raises(ValueError, match="averaging round"):
+            master.execute_training(net, datasets_of(32, 16))
+
+    def test_repartition_never_preserves_order(self):
+        master = ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size_per_worker=4, averaging_frequency=1,
+            repartition=Repartition.NEVER,
+        )
+        data = datasets_of(16, 8, seed=3)
+        splits = list(master._splits(data))
+        x0 = np.concatenate([np.asarray(d.features) for d in data])[:8]
+        np.testing.assert_array_equal(splits[0][0], x0)
+
+
+class TestDistributedEval:
+    def test_merge_equals_serial(self):
+        net = small_net()
+        data = datasets_of(96, 16, seed=5)
+        dist = DistributedEvaluator(num_shards=4).evaluate(net, data)
+        serial = DistributedEvaluator(num_shards=1).evaluate(net, data)
+        assert dist.accuracy() == pytest.approx(serial.accuracy())
+        assert dist.f1() == pytest.approx(serial.f1())
+
+
+class TestStats:
+    def test_timeline_export(self, tmp_path):
+        stats = TrainingStats()
+        with stats.timed("fit", worker_id="w0", example_count=32):
+            pass
+        with stats.timed("aggregate", worker_id="w1"):
+            pass
+        html_path = str(tmp_path / "timeline.html")
+        stats.export_html(html_path)
+        content = open(html_path).read()
+        assert "timeline" in content and "fit" in content and "aggregate" in content
+        json_path = str(tmp_path / "stats.json")
+        stats.export_json(json_path)
+        assert "fit" in open(json_path).read()
+
+    def test_time_sources(self):
+        assert abs(
+            SystemClockTimeSource().current_time_millis()
+            - NTPTimeSource(offset_millis=0).current_time_millis()
+        ) < 1000
+        assert (
+            NTPTimeSource(offset_millis=100_000).current_time_millis()
+            > SystemClockTimeSource().current_time_millis() + 50_000
+        )
+
+
+class TestMultiHost:
+    def test_single_process_defaults(self):
+        assert initialize_multihost(MultiHostConfig()) is False
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["process_index"] == 0
+
+    def test_local_batch_slice_single(self):
+        sl = local_batch_slice(64)
+        assert sl == slice(0, 64)
+
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_COORDINATOR", "10.0.0.1:1234")
+        monkeypatch.setenv("DL4J_TPU_NUM_PROCESSES", "4")
+        monkeypatch.setenv("DL4J_TPU_PROCESS_ID", "2")
+        cfg = MultiHostConfig.from_env()
+        assert cfg.is_configured()
+        assert cfg.num_processes == 4 and cfg.process_id == 2
+
+
+class TestDistributedEarlyStopping:
+    def test_stops_at_max_epochs(self):
+        net = small_net()
+        master = ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size_per_worker=8, averaging_frequency=1,
+        )
+        data = datasets_of(2 * 8 * 1 * 2, 16)
+        cfg = EarlyStoppingConfiguration(
+            epoch_terminations=[MaxEpochsTerminationCondition(3)],
+        )
+        trainer = DistributedEarlyStoppingTrainer(cfg, master, net, data)
+        result = trainer.fit(max_epochs=50)
+        assert result.total_epochs <= 4
+        assert result.best_model is not None
