@@ -1,0 +1,166 @@
+"""Command-line interface: train / test / predict.
+
+Capability mirror of deeplearning4j-cli (SURVEY.md section 2.6):
+CommandLineInterfaceDriver dispatching train/test/predict subcommands
+(deeplearning4j-cli-api/.../cli/driver/CommandLineInterfaceDriver.java:21);
+Train.execute loads a model conf, builds the network, fits an iterator, and
+saves the model (…/cli/subcommands/Train.java:129-227, local path
+:153-181); input/output URI schemes become plain paths with format sniffed
+by extension (.csv — last column is the integer class label; .npz — arrays
+'features'/'labels').
+
+Usage:
+  python -m deeplearning4j_tpu.cli train   --conf conf.json --input train.csv \
+      --output model.zip [--epochs N] [--batch B]
+  python -m deeplearning4j_tpu.cli test    --model model.zip --input test.csv
+  python -m deeplearning4j_tpu.cli predict --model model.zip --input x.csv \
+      [--output preds.csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def load_xy(path: str, num_classes: Optional[int] = None) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """CSV (features..., label) or NPZ {'features', 'labels'} loader (the
+    record-reader role of the reference CLI's input schemes)."""
+    if path.endswith(".npz"):
+        data = np.load(path)
+        x = data["features"].astype(np.float32)
+        y = data["labels"].astype(np.float32) if "labels" in data else None
+        return x, y
+    raw = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+    x = raw[:, :-1].astype(np.float32)
+    labels = raw[:, -1].astype(np.int64)
+    n = num_classes or int(labels.max()) + 1
+    y = np.eye(n, dtype=np.float32)[labels]
+    return x, y
+
+
+def load_x(path: str) -> np.ndarray:
+    if path.endswith(".npz"):
+        return np.load(path)["features"].astype(np.float32)
+    return np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2).astype(
+        np.float32
+    )
+
+
+def _build_net_from_conf(conf_path: str):
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with open(conf_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    d = json.loads(text)
+    if "vertices" in d:
+        return ComputationGraph(ComputationGraphConfiguration.from_json(text))
+    return MultiLayerNetwork(MultiLayerConfiguration.from_json(text))
+
+
+def cmd_train(args) -> int:
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+    net = _build_net_from_conf(args.conf)
+    x, y = load_xy(args.input)
+    if y is None:
+        print("train requires labels (csv last column or npz 'labels')",
+              file=sys.stderr)
+        return 2
+    net.init()
+    net.fit_iterator(
+        ListDataSetIterator(x, y, batch=args.batch), num_epochs=args.epochs
+    )
+    ModelSerializer.write_model(net, args.output)
+    print(f"trained {args.epochs} epoch(s) on {len(x)} examples "
+          f"-> {args.output} (final score {net.score_value:.6f})")
+    return 0
+
+
+def _model_num_classes(net) -> Optional[int]:
+    conf = net.conf
+    if hasattr(conf, "vertices"):  # graph: first output layer's n_out
+        return getattr(conf.vertices[conf.outputs[0]], "n_out", None)
+    return getattr(conf.layers[-1], "n_out", None)
+
+
+def cmd_test(args) -> int:
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+    net = ModelSerializer.restore(args.model)
+    # one-hot width must match the MODEL's output size, not the test file's
+    # max label (a test split missing top classes would shrink it)
+    x, y = load_xy(args.input, num_classes=_model_num_classes(net))
+    if y is None:
+        print("test requires labels (csv last column or npz 'labels')",
+              file=sys.stderr)
+        return 2
+    out = net.output(x)
+    out0 = out[0] if isinstance(out, (list, tuple)) else out
+    ev = Evaluation()
+    ev.eval(np.asarray(y), np.asarray(out0))
+    print(ev.stats())
+    return 0
+
+
+def cmd_predict(args) -> int:
+    from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+    net = ModelSerializer.restore(args.model)
+    x = load_x(args.input)
+    out = net.output(x)
+    out0 = np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+    if args.output:
+        np.savetxt(args.output, out0, delimiter=",", fmt="%.8g")
+        print(f"wrote {out0.shape[0]} predictions -> {args.output}")
+    else:
+        for row in out0:
+            print(",".join(f"{v:.8g}" for v in row))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="deeplearning4j-tpu",
+        description="train / test / predict (reference CLI parity)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="fit a model from a conf JSON")
+    t.add_argument("--conf", required=True, help="MultiLayerConfiguration or "
+                   "ComputationGraphConfiguration JSON file")
+    t.add_argument("--input", required=True, help="training data (.csv/.npz)")
+    t.add_argument("--output", required=True, help="model zip path")
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--batch", type=int, default=32)
+    t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("test", help="evaluate a saved model")
+    e.add_argument("--model", required=True)
+    e.add_argument("--input", required=True)
+    e.set_defaults(fn=cmd_test)
+
+    r = sub.add_parser("predict", help="run inference")
+    r.add_argument("--model", required=True)
+    r.add_argument("--input", required=True)
+    r.add_argument("--output", default=None)
+    r.set_defaults(fn=cmd_predict)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

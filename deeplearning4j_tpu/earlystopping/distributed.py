@@ -10,7 +10,6 @@ serial minibatch fits."""
 from __future__ import annotations
 
 from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
-from deeplearning4j_tpu.earlystopping.result import EarlyStoppingResult
 from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
 from deeplearning4j_tpu.parallel.training_master import TrainingMaster
 
@@ -26,26 +25,10 @@ class DistributedEarlyStoppingTrainer(EarlyStoppingTrainer):
         super().__init__(config, net, train_iterator)
         self.training_master = training_master
 
-    def fit(self, max_epochs: int = 1_000_000) -> EarlyStoppingResult:
-        # Reuse the serial epoch loop but swap the per-epoch fit: one
-        # TrainingMaster round == one "epoch" (SparkEarlyStoppingTrainer
-        # semantics: each epoch is a full executeTraining over the RDD).
-        master = self.training_master
-        net = self.net
-        iterator = self.train_iterator
-
-        class _MasterEpochIterator:
-            """Adapter: iterating it performs the distributed round and
-            yields nothing (losses are tracked on the net), so the base
-            trainer's minibatch loop degenerates to one master call."""
-
-            def __iter__(self):
-                master.execute_training(net, iterator)
-                return iter(())
-
-            def reset(self):
-                if hasattr(iterator, "reset"):
-                    iterator.reset()
-
-        inner = EarlyStoppingTrainer(self.config, net, _MasterEpochIterator())
-        return inner.fit(max_epochs=max_epochs)
+    def _epoch_losses(self):
+        """One TrainingMaster round == one epoch (SparkEarlyStoppingTrainer
+        semantics: each epoch is a full executeTraining over the RDD); the
+        round's final score feeds the iteration terminations so NaN/
+        divergence conditions still fire."""
+        self.training_master.execute_training(self.net, self.train_iterator)
+        yield float(self.net.score_value)

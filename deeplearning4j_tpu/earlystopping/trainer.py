@@ -44,6 +44,12 @@ class EarlyStoppingTrainer:
         self.net = net
         self.train_iterator = train_iterator
 
+    def _epoch_losses(self):
+        """Yield one loss per training unit within an epoch (overridable —
+        the distributed trainer yields one loss per master round)."""
+        for ds in self.train_iterator:
+            yield fit_dataset(self.net, ds)
+
     def fit(self, max_epochs: int = 1_000_000) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.epoch_terminations + cfg.iteration_terminations:
@@ -57,8 +63,7 @@ class EarlyStoppingTrainer:
         try:
             for epoch in range(max_epochs):
                 stop_iter = None
-                for ds in self.train_iterator:
-                    loss = fit_dataset(self.net, ds)
+                for loss in self._epoch_losses():
                     for c in cfg.iteration_terminations:
                         if c.terminate(loss):
                             stop_iter = c

@@ -78,12 +78,14 @@ class ParallelWrapper:
         m = None if mask is None else jax.device_put(jnp.asarray(mask), self.data_sharding)
         lm = None if label_mask is None else jax.device_put(jnp.asarray(label_mask), self.data_sharding)
         step = net._get_train_step(m is not None, lm is not None)
-        srng = rng_mod.step_key(net._rng, net.iteration)
-        net.params, net.states, net.updater_state, loss = step(
-            net.params, net.states, net.updater_state, x, y,
-            jnp.asarray(net.iteration, jnp.int32), srng, m, lm,
-        )
-        net._record_iteration(loss)
+        loss = None
+        for _ in range(max(1, net.conf.iterations)):  # same loop as net.fit
+            srng = rng_mod.step_key(net._rng, net.iteration)
+            net.params, net.states, net.updater_state, loss = step(
+                net.params, net.states, net.updater_state, x, y,
+                jnp.asarray(net.iteration, jnp.int32), srng, m, lm,
+            )
+            net._record_iteration(loss)
         return loss
 
     def _check_divisible(self, b: int) -> None:

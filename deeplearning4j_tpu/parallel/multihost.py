@@ -94,7 +94,8 @@ def local_batch_slice(global_batch: int) -> slice:
     i-th balanced contiguous slice."""
     import jax
 
-    n, i = jax.process_count(), jax.process_index()
-    base, extra = divmod(global_batch, n)
-    start = i * base + min(i, extra)
-    return slice(start, start + base + (1 if i < extra else 0))
+    from deeplearning4j_tpu.parallel.training_master import balanced_splits
+
+    return balanced_splits(global_batch, jax.process_count())[
+        jax.process_index()
+    ]

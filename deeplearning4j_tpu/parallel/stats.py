@@ -127,6 +127,7 @@ class TrainingStats:
             (e.start_time_ms - t0 + e.duration_ms for e in self.events),
             default=1.0,
         )
+        total_span = max(total_span, 1e-6)  # all-zero-duration guard
         for e in self.events:
             left = 100.0 * (e.start_time_ms - t0) / total_span
             width = max(0.2, 100.0 * e.duration_ms / total_span)

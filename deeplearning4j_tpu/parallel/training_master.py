@@ -184,11 +184,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             attempt = 0
             while True:
                 try:
-                    if stats:
-                        with stats.timed("fit", example_count=len(x)):
-                            self._trainer.fit(x, y)
-                    else:
-                        self._trainer.fit(x, y)
+                    t0 = stats.time_source.current_time_millis() if stats else 0
+                    p0 = time.perf_counter()
+                    self._trainer.fit(x, y)
+                    if stats:  # record successful attempts only
+                        stats.record(
+                            "fit", t0, (time.perf_counter() - p0) * 1000.0,
+                            example_count=len(x),
+                        )
                     break
                 except Exception:
                     # Spark retries failed tasks natively (SURVEY.md section 5

@@ -1,0 +1,26 @@
+"""Streaming inference + training — capability surface of dl4j-streaming
+(SURVEY.md section 2.4): record<->array conversion, base64 record serde,
+a model-serving endpoint (DL4jServeRouteBuilder role: load checkpoint,
+predict per record), and a streaming-training pipeline (SparkStreamingPipeline
+role: record stream -> DataSet minibatches -> fit). Kafka/Camel transports
+are replaced by a pluggable in-process queue + stdlib HTTP endpoint (this
+environment has no brokers); the route interfaces keep the same shape so a
+real transport can be slotted in."""
+
+from deeplearning4j_tpu.streaming.conversion import (
+    record_to_array,
+    array_to_record,
+    encode_record_base64,
+    decode_record_base64,
+)
+from deeplearning4j_tpu.streaming.serving import ModelServer
+from deeplearning4j_tpu.streaming.pipeline import StreamingTrainingPipeline
+
+__all__ = [
+    "record_to_array",
+    "array_to_record",
+    "encode_record_base64",
+    "decode_record_base64",
+    "ModelServer",
+    "StreamingTrainingPipeline",
+]

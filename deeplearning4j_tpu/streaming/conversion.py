@@ -1,0 +1,38 @@
+"""Record <-> array conversion + base64 serde.
+
+Capability mirror of dl4j-streaming conversion/serde
+(dl4j-streaming/.../streaming/conversion/{RecordToNDArray,
+NDArrayToWritablesFunction}.java and …/streaming/serde/ base64 record
+serde): records are flat lists of values (the Canova Writable row), arrays
+are float32 numpy; base64 wraps the raw little-endian float bytes for wire
+transport (Kafka payloads in the reference)."""
+
+from __future__ import annotations
+
+import base64
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+
+def record_to_array(record: Sequence) -> np.ndarray:
+    """One record (sequence of numbers/strings) -> float32 vector."""
+    return np.array([float(v) for v in record], np.float32)
+
+
+def array_to_record(arr: np.ndarray) -> List[float]:
+    return [float(v) for v in np.asarray(arr).reshape(-1)]
+
+
+def encode_record_base64(record: Sequence) -> str:
+    """Record -> base64(le float32 bytes) (reference RecordSerializer)."""
+    arr = record_to_array(record)
+    return base64.b64encode(arr.tobytes()).decode("ascii")
+
+
+def decode_record_base64(payload: str) -> np.ndarray:
+    raw = base64.b64decode(payload)
+    if len(raw) % 4 != 0:
+        raise ValueError("payload length not a multiple of float32 size")
+    return np.frombuffer(raw, dtype=np.float32).copy()

@@ -1,0 +1,115 @@
+"""Model serving endpoint.
+
+Capability mirror of DL4jServeRouteBuilder (dl4j-streaming/.../streaming/
+routes/DL4jServeRouteBuilder.java: Camel route that loads a serialized model
+and runs output() on each incoming record): a stdlib HTTP server exposing
+
+  POST /predict   {"record": [..floats..]}            -> {"output": [...]}
+                  {"record_base64": "<b64 floats>"}   -> {"output": [...]}
+                  {"batch": [[...], ...]}             -> {"outputs": [[...], ...]}
+  GET  /health    {"ok": true, "model": "<type>"}
+
+The model is restored once at startup (ModelSerializer.restore — the same
+checkpoint the reference route consumes) and shared across requests; the
+jitted forward compiles on first request per batch shape, so sticky batch
+sizes serve at device speed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.streaming.conversion import decode_record_base64
+
+
+class ModelServer:
+    def __init__(self, model=None, model_path: Optional[str] = None,
+                 port: int = 0, input_shape=None):
+        """model: a live network, or model_path: a ModelSerializer zip."""
+        if model is None:
+            if model_path is None:
+                raise ValueError("need model or model_path")
+            from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+            model = ModelSerializer.restore(model_path)
+        self.model = model
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"ok": True,
+                                     "model": type(server.model).__name__})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n))
+                    if "record_base64" in payload:
+                        x = decode_record_base64(payload["record_base64"])[None]
+                    elif "record" in payload:
+                        x = np.asarray(payload["record"], np.float32)[None]
+                    elif "batch" in payload:
+                        x = np.asarray(payload["batch"], np.float32)
+                    else:
+                        self._send(400, {"error": "need record|record_base64|batch"})
+                        return
+                    out = server.predict(x)
+                    key = "outputs" if "batch" in payload else "output"
+                    val = out.tolist() if "batch" in payload else out[0].tolist()
+                    self._send(200, {key: val})
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        if self.input_shape is not None:
+            x = x.reshape((x.shape[0],) + self.input_shape)
+        with self._lock:  # containers mutate rnn state; serialize access
+            out = self.model.output(x)
+        out0 = out[0] if isinstance(out, (list, tuple)) else out
+        return np.asarray(out0)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ModelServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

@@ -1,0 +1,49 @@
+"""UI / monitoring — capability surface of deeplearning4j-ui-parent
+(SURVEY.md section 2.5): the chart-component DSL with JSON serde and
+standalone static-page export (deeplearning4j-ui-components), the training
+UI server (UiServer + HistoryStorage), and the training listeners that
+publish to it (HistogramIterationListener, FlowIterationListener,
+ConvolutionalIterationListener).
+
+TPU-era redesign: the reference's Dropwizard/Jetty + React + d3 stack
+becomes a stdlib http.server plus SERVER-SIDE SVG rendering — zero JS/CDN
+dependencies (this environment has no egress), same component model."""
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    ChartTimeline,
+    ComponentTable,
+    ComponentText,
+    StyleChart,
+    component_from_dict,
+    render_page,
+)
+from deeplearning4j_tpu.ui.listeners import (
+    ConvolutionalIterationListener,
+    FlowIterationListener,
+    HistogramIterationListener,
+)
+from deeplearning4j_tpu.ui.server import HistoryStorage, UiServer
+
+__all__ = [
+    "ChartHistogram",
+    "ChartHorizontalBar",
+    "ChartLine",
+    "ChartScatter",
+    "ChartStackedArea",
+    "ChartTimeline",
+    "ComponentTable",
+    "ComponentText",
+    "StyleChart",
+    "component_from_dict",
+    "render_page",
+    "HistogramIterationListener",
+    "FlowIterationListener",
+    "ConvolutionalIterationListener",
+    "HistoryStorage",
+    "UiServer",
+]

@@ -1,0 +1,180 @@
+"""UI-publishing iteration listeners.
+
+Capability mirror of the reference training listeners (SURVEY.md 2.5):
+  - HistogramIterationListener (…/ui/weights/HistogramIterationListener.java:33
+    — binned param/gradient/score JSON posted to the UI every N iterations;
+    wire bean CompactModelAndGradient);
+  - FlowIterationListener (…/ui/flow/FlowIterationListener.java:46 — live
+    topology + per-layer info beans LayerInfo/ModelInfo);
+  - ConvolutionalIterationListener (…/ui/weights/
+    ConvolutionalIterationListener.java:38 — conv activation grids).
+
+Each listener can post to a UiServer (HTTP, the reference behavior) or just
+accumulate locally (storage=...) for headless use / static export.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.ui.server import HistoryStorage
+
+
+def _flatten_params(model) -> Dict[str, np.ndarray]:
+    out = {}
+    params = model.params
+    if isinstance(params, dict):  # ComputationGraph: name -> {pname: arr}
+        for lname, ps in params.items():
+            for pname, arr in (ps or {}).items():
+                out[f"{lname}_{pname}"] = np.asarray(arr)
+    else:  # MultiLayerNetwork: list of {pname: arr}
+        for i, ps in enumerate(params or []):
+            for pname, arr in (ps or {}).items():
+                out[f"{i}_{pname}"] = np.asarray(arr)
+    return out
+
+
+class _PostingListener(IterationListener):
+    def __init__(self, server_url: Optional[str] = None,
+                 storage: Optional[HistoryStorage] = None):
+        self.server_url = server_url
+        self.storage = storage or (None if server_url else HistoryStorage())
+
+    def _publish(self, payload: Dict[str, Any]) -> None:
+        if self.server_url:
+            try:
+                req = urllib.request.Request(
+                    self.server_url.rstrip("/") + "/train/update",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except (urllib.error.URLError, OSError) as e:
+                # monitoring must never abort training — log and continue
+                logger.warning("UI post failed (%s); continuing", e)
+        if self.storage is not None:
+            self.storage.put(payload["type"], payload)
+
+
+class HistogramIterationListener(_PostingListener):
+    """Bin every param tensor + score each N iterations."""
+
+    def __init__(self, frequency: int = 10, num_bins: int = 20, **kw):
+        super().__init__(**kw)
+        self.frequency = max(1, frequency)
+        self.num_bins = num_bins
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.frequency != 0:
+            return
+        params = {}
+        for name, arr in _flatten_params(model).items():
+            flat = arr.reshape(-1)
+            counts, edges = np.histogram(flat, bins=self.num_bins)
+            params[name] = {
+                "lower": edges[:-1].tolist(),
+                "upper": edges[1:].tolist(),
+                "counts": counts.tolist(),
+                "mean": float(flat.mean()),
+                "std": float(flat.std()),
+            }
+        self._publish({
+            "type": "histogram",
+            "iteration": iteration,
+            "score": float(score),
+            "params": params,
+        })
+        self._publish({
+            "type": "score", "iteration": iteration, "score": float(score),
+        })
+
+
+class FlowIterationListener(_PostingListener):
+    """Topology + per-layer beans (LayerInfo/ModelInfo)."""
+
+    def __init__(self, frequency: int = 10, **kw):
+        super().__init__(**kw)
+        self.frequency = max(1, frequency)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.frequency != 0:
+            return
+        layers: List[Dict[str, Any]] = []
+        conf = model.conf
+        if hasattr(conf, "vertices"):  # graph
+            for name in conf.topological_order():
+                v = conf.vertices[name]
+                ps = model.params.get(name, {}) if model.params else {}
+                layers.append({
+                    "name": name,
+                    "layer_type": type(v).__name__,
+                    "n_params": int(sum(np.asarray(a).size for a in ps.values())),
+                    "inputs": list(conf.vertex_inputs.get(name, [])),
+                })
+        else:
+            for i, lc in enumerate(conf.layers):
+                ps = model.params[i] if model.params else {}
+                layers.append({
+                    "name": str(i),
+                    "layer_type": type(lc).__name__,
+                    "n_params": int(sum(np.asarray(a).size for a in ps.values())),
+                    "inputs": [str(i - 1)] if i else [],
+                })
+        self._publish({
+            "type": "flow",
+            "iteration": iteration,
+            "score": float(score),
+            "layers": layers,
+        })
+
+
+class ConvolutionalIterationListener(_PostingListener):
+    """Conv activation grids: stores per-channel [H, W] activation maps of
+    the first example, normalized to [0, 1] (the reference renders these as
+    image tiles; export via ui.components / render_page)."""
+
+    def __init__(self, frequency: int = 10, max_channels: int = 16, **kw):
+        super().__init__(**kw)
+        self.frequency = max(1, frequency)
+        self.max_channels = max_channels
+        self._last_input = None
+
+    def set_input(self, x) -> None:
+        """Give the listener the minibatch to trace (the reference pulls
+        activations from the layer workspace; functionally we re-run)."""
+        self._last_input = np.asarray(x)
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        if iteration % self.frequency != 0 or self._last_input is None:
+            return
+        acts = model.feed_forward(self._last_input[:1], train=False)
+        grids: Dict[str, List[List[float]]] = {}
+        seq = (
+            acts if isinstance(acts, list)
+            else [acts[k] for k in sorted(acts)]
+        )
+        for li, a in enumerate(seq):
+            a = np.asarray(a)
+            if a.ndim != 4:  # NHWC conv maps only
+                continue
+            for c in range(min(a.shape[-1], self.max_channels)):
+                g = a[0, :, :, c]
+                lo, hi = float(g.min()), float(g.max())
+                norm = (g - lo) / (hi - lo) if hi > lo else g * 0
+                grids[f"layer{li}_ch{c}"] = np.round(norm, 4).tolist()
+        self._publish({
+            "type": "activations",
+            "iteration": iteration,
+            "grids": grids,
+        })

@@ -1,0 +1,160 @@
+"""Training UI server + storage.
+
+Capability mirror of the reference UiServer (deeplearning4j-ui/.../ui/
+UiServer.java:70 — Dropwizard web app with REST resources receiving listener
+posts) and HistoryStorage (…/ui/storage/HistoryStorage.java — keyed
+session history).
+
+stdlib-only: http.server in a daemon thread; listeners POST JSON updates to
+/train/update; GET / renders the dashboard server-side from the stored
+history (score line, per-layer param histograms, topology table).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ComponentTable,
+    ComponentText,
+    render_page,
+)
+
+
+class HistoryStorage:
+    """Keyed, bounded history of listener updates (HistoryStorage.java)."""
+
+    def __init__(self, max_items_per_key: int = 2048):
+        self._data: Dict[str, List[Any]] = {}
+        self._lock = threading.Lock()
+        self.max_items = max_items_per_key
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            items = self._data.setdefault(key, [])
+            items.append(value)
+            if len(items) > self.max_items:
+                del items[: len(items) - self.max_items]
+
+    def get(self, key: str) -> List[Any]:
+        with self._lock:
+            return list(self._data.get(key, []))
+
+    def latest(self, key: str) -> Optional[Any]:
+        with self._lock:
+            items = self._data.get(key)
+            return items[-1] if items else None
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._data)
+
+
+class UiServer:
+    """POST /train/update  {type: score|histogram|flow, ...}
+    GET  /train/summary   JSON dump of latest state
+    GET  /                server-rendered dashboard"""
+
+    def __init__(self, port: int = 0, storage: Optional[HistoryStorage] = None):
+        self.storage = storage or HistoryStorage()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/train/update":
+                    self._send(404, b"not found", "text/plain")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(n))
+                    key = payload.get("type", "unknown")
+                    server.storage.put(key, payload)
+                    self._send(200, b'{"ok":true}', "application/json")
+                except (ValueError, KeyError) as e:
+                    self._send(400, str(e).encode(), "text/plain")
+
+            def do_GET(self):
+                if self.path == "/train/summary":
+                    out = {
+                        k: server.storage.latest(k) for k in server.storage.keys()
+                    }
+                    self._send(
+                        200, json.dumps(out).encode(), "application/json"
+                    )
+                elif self.path == "/":
+                    self._send(
+                        200, server.render_dashboard().encode(), "text/html"
+                    )
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "UiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- rendering --------------------------------------------------------
+    def render_dashboard(self) -> str:
+        comps = []
+        scores = self.storage.get("score")
+        if scores:
+            chart = ChartLine(title="Score vs iteration")
+            chart.add_series(
+                "score",
+                [s["iteration"] for s in scores],
+                [s["score"] for s in scores],
+            )
+            comps.append(chart)
+        hist = self.storage.latest("histogram")
+        if hist:
+            for name, h in hist.get("params", {}).items():
+                c = ChartHistogram(title=f"param {name}")
+                for lo, hi, cnt in zip(h["lower"], h["upper"], h["counts"]):
+                    c.add_bin(lo, hi, cnt)
+                comps.append(c)
+        flow = self.storage.latest("flow")
+        if flow:
+            table = ComponentTable(
+                title="Network topology",
+                header=["layer", "type", "n_params"],
+                rows=[
+                    [l["name"], l["layer_type"], str(l["n_params"])]
+                    for l in flow.get("layers", [])
+                ],
+            )
+            comps.append(table)
+        if not comps:
+            comps = [ComponentText(text="no training data posted yet")]
+        return render_page(comps, title="DL4J-TPU training")

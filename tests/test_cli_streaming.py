@@ -1,0 +1,205 @@
+"""CLI + streaming tests — mirrors the reference CLI subcommand tests
+(deeplearning4j-cli TrainTest) and streaming route tests
+(Dl4jServingRouteTest with embedded broker; here in-process HTTP)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli import main as cli_main
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.streaming import (
+    ModelServer,
+    StreamingTrainingPipeline,
+    decode_record_base64,
+    encode_record_base64,
+    record_to_array,
+)
+
+
+def write_conf(path):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .learning_rate(0.1)
+        .updater("sgd")
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=16, activation="tanh"))
+        .layer(1, OutputLayer(n_in=16, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    path.write_text(conf.to_json())
+    return conf
+
+
+def write_csv(path, n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    w = np.random.default_rng(42).normal(size=(4, 3))
+    labels = np.argmax(x @ w, axis=1)
+    np.savetxt(path, np.column_stack([x, labels]), delimiter=",", fmt="%.6f")
+    return x, labels
+
+
+class TestCli:
+    def test_train_test_predict_roundtrip(self, tmp_path, capsys):
+        conf_path = tmp_path / "conf.json"
+        train_csv = tmp_path / "train.csv"
+        model_zip = tmp_path / "model.zip"
+        write_conf(conf_path)
+        write_csv(train_csv, n=192, seed=0)
+
+        rc = cli_main([
+            "train", "--conf", str(conf_path), "--input", str(train_csv),
+            "--output", str(model_zip), "--epochs", "15", "--batch", "32",
+        ])
+        assert rc == 0 and model_zip.exists()
+
+        test_csv = tmp_path / "test.csv"
+        write_csv(test_csv, n=48, seed=5)
+        rc = cli_main(["test", "--model", str(model_zip), "--input", str(test_csv)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Accuracy" in out
+
+        # predict consumes UNLABELED input (features only)
+        x_only_csv = tmp_path / "x_only.csv"
+        x_test = np.loadtxt(test_csv, delimiter=",")[:, :-1]
+        np.savetxt(x_only_csv, x_test, delimiter=",", fmt="%.6f")
+        pred_csv = tmp_path / "preds.csv"
+        rc = cli_main([
+            "predict", "--model", str(model_zip), "--input", str(x_only_csv),
+            "--output", str(pred_csv),
+        ])
+        assert rc == 0
+        preds = np.loadtxt(pred_csv, delimiter=",")
+        assert preds.shape == (48, 3)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-3)
+
+    def test_npz_input(self, tmp_path):
+        conf_path = tmp_path / "conf.json"
+        write_conf(conf_path)
+        x = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.random.default_rng(1).integers(0, 3, 64)]
+        npz = tmp_path / "data.npz"
+        np.savez(npz, features=x, labels=y)
+        model_zip = tmp_path / "m.zip"
+        rc = cli_main([
+            "train", "--conf", str(conf_path), "--input", str(npz),
+            "--output", str(model_zip), "--epochs", "1",
+        ])
+        assert rc == 0 and model_zip.exists()
+
+
+class TestConversion:
+    def test_record_roundtrip(self):
+        rec = [1.5, -2.0, 3.25]
+        b64 = encode_record_base64(rec)
+        back = decode_record_base64(b64)
+        np.testing.assert_allclose(back, record_to_array(rec))
+
+    def test_bad_payload_rejected(self):
+        with pytest.raises(ValueError):
+            decode_record_base64("AAA=")  # 3 bytes, not float32-aligned
+
+
+def trained_net():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7).learning_rate(0.1).list()
+        .layer(0, DenseLayer(n_in=4, n_out=16, activation="tanh"))
+        .layer(1, OutputLayer(n_in=16, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    net.fit(x, y)
+    return net
+
+
+class TestModelServer:
+    @pytest.fixture(scope="class")
+    def server(self):
+        s = ModelServer(model=trained_net(), port=0).start()
+        yield s
+        s.stop()
+
+    def _post(self, server, payload):
+        req = urllib.request.Request(
+            server.url + "/predict", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def test_health(self, server):
+        with urllib.request.urlopen(server.url + "/health", timeout=5) as r:
+            h = json.loads(r.read())
+        assert h["ok"] and "MultiLayerNetwork" in h["model"]
+
+    def test_predict_record(self, server):
+        out = self._post(server, {"record": [0.1, -0.2, 0.3, 0.4]})
+        assert len(out["output"]) == 3
+        assert abs(sum(out["output"]) - 1.0) < 1e-3
+
+    def test_predict_base64(self, server):
+        payload = {"record_base64": encode_record_base64([0.1, -0.2, 0.3, 0.4])}
+        out = self._post(server, payload)
+        assert len(out["output"]) == 3
+
+    def test_predict_batch(self, server):
+        out = self._post(server, {"batch": [[0.1] * 4, [0.2] * 4]})
+        assert len(out["outputs"]) == 2
+
+    def test_bad_request(self, server):
+        with pytest.raises(urllib.error.HTTPError):
+            self._post(server, {"nope": 1})
+
+    def test_restore_from_checkpoint(self, tmp_path):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        net = trained_net()
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.write_model(net, p)
+        s = ModelServer(model_path=p, port=0).start()
+        try:
+            out = self._post(s, {"record": [0.1, -0.2, 0.3, 0.4]})
+            direct = np.asarray(net.output(np.array([[0.1, -0.2, 0.3, 0.4]],
+                                                    np.float32)))[0]
+            np.testing.assert_allclose(out["output"], direct, rtol=1e-4)
+        finally:
+            s.stop()
+
+
+class TestStreamingPipeline:
+    def test_stream_training(self):
+        net_conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1).list()
+            .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build()
+        )
+        net = MultiLayerNetwork(net_conf)
+        pipe = StreamingTrainingPipeline(net, num_classes=3, batch_size=16)
+        pipe.start()
+        rng = np.random.default_rng(0)
+        w = np.random.default_rng(42).normal(size=(4, 3))
+        for _ in range(64):
+            rec = rng.normal(size=4)
+            pipe.publish(rec, int(np.argmax(rec @ w)))
+        pipe.stop()
+        assert pipe.batches_fit == 4
+        assert all(np.isfinite(l) for l in pipe.losses)

@@ -1,0 +1,166 @@
+"""UI tests — mirrors the reference UI test strategy (SURVEY.md section 4:
+TestComponentSerialization, TestRendering, ApiTest server smoke)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    ChartTimeline,
+    ComponentTable,
+    ComponentText,
+    FlowIterationListener,
+    HistogramIterationListener,
+    HistoryStorage,
+    UiServer,
+    component_from_dict,
+    render_page,
+)
+
+
+def all_components():
+    line = ChartLine(title="L").add_series("a", [0, 1, 2], [1.0, 0.5, 0.2])
+    line.add_series("b", [0, 1, 2], [0.2, 0.3, 0.4])
+    scatter = ChartScatter(title="S").add_series("pts", [0, 1], [1, 0])
+    hist = ChartHistogram(title="H").add_bin(0, 1, 5).add_bin(1, 2, 3)
+    stacked = ChartStackedArea(title="SA")
+    stacked.add_series("x", [0, 1, 2], [1, 1, 1])
+    stacked.add_series("y", [0, 1, 2], [2, 1, 0.5])
+    bars = ChartHorizontalBar(title="B").add_bar("w", 3.0).add_bar("b", 1.5)
+    tl = ChartTimeline(title="T").add_lane("w0", [(0, 10, "fit"), (10, 12, "avg")])
+    table = ComponentTable(title="tab", header=["a", "b"], rows=[["1", "2"]])
+    text = ComponentText(title="", text="hello")
+    return [line, scatter, hist, stacked, bars, tl, table, text]
+
+
+class TestComponentSerde:
+    def test_json_roundtrip_all(self):
+        for comp in all_components():
+            d = json.loads(comp.to_json())
+            restored = component_from_dict(d)
+            assert restored.to_dict() == comp.to_dict(), type(comp).__name__
+
+    def test_render_all_produce_markup(self):
+        for comp in all_components():
+            markup = comp.render()
+            assert ("<svg" in markup) or ("<table" in markup) or ("<p" in markup)
+
+    def test_static_page_export(self, tmp_path):
+        page = render_page(all_components(), title="export test")
+        assert page.count("<svg") >= 6
+        assert "export test" in page
+        # self-contained: no external scripts/stylesheets/images
+        assert "<script" not in page and "<link" not in page
+        assert "src=" not in page
+
+
+class TestUiServer:
+    @pytest.fixture()
+    def server(self):
+        s = UiServer(port=0).start()
+        yield s
+        s.stop()
+
+    def _post(self, server, payload):
+        req = urllib.request.Request(
+            server.url + "/train/update",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status
+
+    def test_post_and_summary(self, server):
+        assert self._post(server, {"type": "score", "iteration": 0,
+                                   "score": 1.5}) == 200
+        with urllib.request.urlopen(server.url + "/train/summary", timeout=5) as r:
+            summary = json.loads(r.read())
+        assert summary["score"]["score"] == 1.5
+
+    def test_dashboard_renders(self, server):
+        self._post(server, {"type": "score", "iteration": 0, "score": 2.0})
+        self._post(server, {"type": "score", "iteration": 1, "score": 1.0})
+        with urllib.request.urlopen(server.url + "/", timeout=5) as r:
+            page = r.read().decode()
+        assert "Score vs iteration" in page and "<svg" in page
+
+    def test_404(self, server):
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(server.url + "/nope", timeout=5)
+
+
+def small_net():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .learning_rate(0.1)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestListeners:
+    def test_histogram_listener_local_storage(self):
+        net = small_net()
+        listener = HistogramIterationListener(frequency=1)
+        net.set_listeners(listener)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y)
+        hist = listener.storage.latest("histogram")
+        assert hist is not None
+        assert "0_W" in hist["params"]
+        assert len(hist["params"]["0_W"]["counts"]) == 20
+
+    def test_flow_listener_topology(self):
+        net = small_net()
+        listener = FlowIterationListener(frequency=1)
+        net.set_listeners(listener)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        net.fit(x, y)
+        flow = listener.storage.latest("flow")
+        assert [l["layer_type"] for l in flow["layers"]] == [
+            "DenseLayer", "OutputLayer",
+        ]
+
+    def test_listener_posts_to_server(self):
+        server = UiServer(port=0).start()
+        try:
+            net = small_net()
+            net.set_listeners(
+                HistogramIterationListener(frequency=1, server_url=server.url)
+            )
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(8, 4)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+            net.fit(x, y)
+            with urllib.request.urlopen(server.url + "/train/summary",
+                                        timeout=5) as r:
+                summary = json.loads(r.read())
+            assert "histogram" in summary and "score" in summary
+            with urllib.request.urlopen(server.url + "/", timeout=5) as r:
+                page = r.read().decode()
+            assert "<svg" in page
+        finally:
+            server.stop()
