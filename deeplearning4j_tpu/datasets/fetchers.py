@@ -40,6 +40,9 @@ def _open_maybe_gz(path: Path):
 
 
 def read_idx_images(path: Path) -> np.ndarray:
+    # np.frombuffer on the raw ubyte payload is already a single-copy parse;
+    # the native dl4j_read_idx exists as a standalone API (float32 idx, C
+    # consumers) and would only add copies here.
     with _open_maybe_gz(path) as f:
         magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
         if magic != 2051:

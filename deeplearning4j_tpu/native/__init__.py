@@ -1,0 +1,251 @@
+"""ctypes bindings for the native host-runtime library.
+
+The C++ side (native/src/dl4j_tpu_native.cpp) provides the host IO layer
+the reference implements natively (idx/CSV parsing, deterministic shuffle,
+threaded prefetch ring buffer — the nd4j-native/Canova/AsyncDataSetIterator
+roles, SURVEY.md L0/L5). Every entry point has a pure-Python fallback so
+the framework works without the compiled library; `NATIVE_AVAILABLE` tells
+you which path is active. Build with `make -C native` (auto-attempted once
+on import if a toolchain is present).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_LIB_NAME = "libdl4j_tpu_native.so"
+_LIB_PATH = os.path.join(os.path.dirname(__file__), _LIB_NAME)
+_lib: Optional[ctypes.CDLL] = None
+_build_attempted = False
+
+
+def _try_build() -> None:
+    global _build_attempted
+    if _build_attempted:  # one shot — never re-spawn make per call
+        return
+    _build_attempted = True
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "native"
+    )
+    makefile = os.path.join(native_dir, "Makefile")
+    if not os.path.exists(makefile):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", native_dir], check=True, capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug("native build skipped: %s", e)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        _try_build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.dl4j_read_idx.restype = ctypes.c_int
+    lib.dl4j_read_idx.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+    ]
+    lib.dl4j_free.argtypes = [ctypes.c_void_p]
+    lib.dl4j_csv_read.restype = ctypes.c_int
+    lib.dl4j_csv_read.argtypes = [
+        ctypes.c_char_p, ctypes.c_char,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+    ]
+    lib.dl4j_shuffle_indices.argtypes = [
+        ctypes.c_int64, ctypes.c_uint64, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.dl4j_prefetch_start.restype = ctypes.c_void_p
+    lib.dl4j_prefetch_start.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+    ]
+    lib.dl4j_prefetch_next.restype = ctypes.c_int
+    lib.dl4j_prefetch_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.dl4j_prefetch_stop.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# idx / CSV / shuffle with fallbacks
+# ---------------------------------------------------------------------------
+
+
+def read_idx(path: str, normalize: bool = True) -> np.ndarray:
+    """Parse an MNIST idx file (reference datasets/mnist idx readers)."""
+    lib = _load()
+    if lib is None:
+        return _read_idx_py(path, normalize)
+    ndim = ctypes.c_int()
+    dims = (ctypes.c_int64 * 4)()
+    data = ctypes.POINTER(ctypes.c_float)()
+    rc = lib.dl4j_read_idx(path.encode(), int(normalize),
+                           ctypes.byref(ndim), dims, ctypes.byref(data))
+    if rc != 0:
+        raise IOError(f"dl4j_read_idx({path}) failed: {rc}")
+    shape = tuple(dims[i] for i in range(ndim.value))
+    n = int(np.prod(shape))
+    out = np.ctypeslib.as_array(data, shape=(n,)).astype(np.float32).reshape(shape)
+    lib.dl4j_free(data)
+    return out
+
+
+def _read_idx_py(path: str, normalize: bool) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic = f.read(4)
+        dtype, ndim = magic[2], magic[3]
+        shape = tuple(
+            int.from_bytes(f.read(4), "big") for _ in range(ndim)
+        )
+        if dtype == 0x08:
+            arr = np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+            out = arr.astype(np.float32)
+            return out / 255.0 if normalize else out
+        if dtype == 0x0D:
+            return np.frombuffer(f.read(), dtype=">f4").reshape(shape).astype(
+                np.float32
+            )
+    raise IOError(f"unsupported idx dtype {dtype:#x}")
+
+
+def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
+    """Bulk numeric CSV -> float32 [rows, cols]."""
+    lib = _load()
+    if lib is None:
+        return np.loadtxt(path, delimiter=delimiter, ndmin=2).astype(np.float32)
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    data = ctypes.POINTER(ctypes.c_float)()
+    d = delimiter.encode()[0:1]
+    rc = lib.dl4j_csv_read(path.encode(), d, ctypes.byref(rows),
+                           ctypes.byref(cols), ctypes.byref(data))
+    if rc != 0:
+        raise IOError(f"dl4j_csv_read({path}) failed: {rc}")
+    if rows.value == 0:
+        return np.zeros((0, 0), np.float32)
+    n = rows.value * cols.value
+    out = np.ctypeslib.as_array(data, shape=(n,)).astype(np.float32).reshape(
+        rows.value, cols.value
+    )
+    lib.dl4j_free(data)
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Deterministic cross-platform Fisher-Yates permutation."""
+    lib = _load()
+    out = np.empty((n,), np.int64)
+    if lib is None:
+        return _shuffle_py(n, seed)
+    lib.dl4j_shuffle_indices(
+        n, seed & 0xFFFFFFFFFFFFFFFF,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return out
+
+
+def _splitmix64(state: int) -> Tuple[int, int]:
+    state = (state + 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    return state, z ^ (z >> 31)
+
+
+def _shuffle_py(n: int, seed: int) -> np.ndarray:
+    """Bit-exact mirror of the C splitmix64 Fisher-Yates (so shuffles agree
+    whether or not the native library is present)."""
+    out = np.arange(n, dtype=np.int64)
+    state = seed & 0xFFFFFFFFFFFFFFFF
+    for i in range(n - 1, 0, -1):
+        state, r = _splitmix64(state)
+        j = r % (i + 1)
+        out[i], out[j] = out[j], out[i]
+    return out
+
+
+class NativePrefetchIterator:
+    """Threaded minibatch prefetcher over in-memory arrays (the
+    AsyncDataSetIterator role with batch assembly in native code)."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray, batch: int,
+                 epochs: int = 1, seed: int = 0, capacity: int = 4):
+        self.features = np.ascontiguousarray(features, np.float32)
+        self.labels = np.ascontiguousarray(labels, np.float32)
+        self.batch = int(batch)
+        self.epochs = int(epochs)
+        self.seed = int(seed)
+        self.capacity = int(capacity)
+        self._f_len = int(np.prod(self.features.shape[1:]))
+        self._l_len = int(np.prod(self.labels.shape[1:]))
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        lib = _load()
+        if lib is None:
+            yield from self._iter_py()
+            return
+        f2 = self.features.reshape(len(self.features), self._f_len)
+        l2 = self.labels.reshape(len(self.labels), self._l_len)
+        handle = lib.dl4j_prefetch_start(
+            f2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            l2.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            len(f2), self._f_len, self._l_len, self.batch,
+            self.epochs, self.seed & 0xFFFFFFFFFFFFFFFF, self.capacity,
+        )
+        if not handle:
+            yield from self._iter_py()
+            return
+        try:
+            fshape = (self.batch,) + self.features.shape[1:]
+            lshape = (self.batch,) + self.labels.shape[1:]
+            while True:
+                fb = np.empty((self.batch, self._f_len), np.float32)
+                lb = np.empty((self.batch, self._l_len), np.float32)
+                ok = lib.dl4j_prefetch_next(
+                    handle,
+                    fb.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    lb.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                )
+                if not ok:
+                    break
+                yield fb.reshape(fshape), lb.reshape(lshape)
+        finally:
+            lib.dl4j_prefetch_stop(handle)
+
+    def _iter_py(self):
+        # same splitmix64 shuffle chain as the C producer (bit-exact)
+        state = self.seed & 0xFFFFFFFFFFFFFFFF
+        for _ in range(self.epochs):
+            state, derived = _splitmix64(state)
+            idx = _shuffle_py(len(self.features), derived)
+            for b in range(0, len(self.features) - self.batch + 1, self.batch):
+                sel = idx[b : b + self.batch]
+                yield self.features[sel], self.labels[sel]
+
+
+NATIVE_AVAILABLE = native_available()

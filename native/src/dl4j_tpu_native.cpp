@@ -1,0 +1,328 @@
+// Native host-runtime library for deeplearning4j_tpu.
+//
+// Role: the host-side IO/runtime layer that the reference implements
+// natively (SURVEY.md L0: nd4j-native C++ backend; L5 ingest:
+// Canova/DataVec record readers feeding AsyncDataSetIterator,
+// deeplearning4j-core/.../datasets/iterator/AsyncDataSetIterator.java:30).
+// Device compute stays in XLA; this library removes the Python overhead on
+// the feed path: idx (MNIST) parsing, bulk CSV parsing, deterministic
+// shuffling, and a threaded prefetching CSV batch loader (the
+// AsyncDataSetIterator ring buffer, in native code, off the GIL).
+//
+// Pure C ABI so Python binds via ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// idx (MNIST) file parsing — big-endian magic + dims + raw bytes
+// ---------------------------------------------------------------------------
+
+// Reads an idx file. On success returns 0 and fills:
+//   *out_ndim, dims[0..ndim), and *out_data (malloc'd float32 buffer,
+//   caller frees via dl4j_free). Pixel bytes are scaled to [0,1] when
+//   normalize != 0.
+int dl4j_read_idx(const char* path, int normalize, int* out_ndim,
+                  int64_t* dims /* size >= 4 */, float** out_data) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char magic[4];
+  if (fread(magic, 1, 4, f) != 4) { fclose(f); return -2; }
+  int dtype = magic[2];
+  int ndim = magic[3];
+  if (ndim <= 0 || ndim > 4 || (dtype != 0x08 && dtype != 0x0D)) {
+    fclose(f);
+    return -3;
+  }
+  const int64_t kMaxElements = (int64_t)1 << 31;  // 2G elements cap
+  int64_t total = 1;
+  for (int i = 0; i < ndim; i++) {
+    unsigned char b[4];
+    if (fread(b, 1, 4, f) != 4) { fclose(f); return -2; }
+    dims[i] = ((int64_t)b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3];
+    if (dims[i] <= 0 || dims[i] > kMaxElements / total) {  // overflow guard
+      fclose(f);
+      return -3;
+    }
+    total *= dims[i];
+  }
+  float* out = (float*)malloc(sizeof(float) * (size_t)total);
+  if (!out) { fclose(f); return -4; }
+  if (dtype == 0x08) {  // unsigned byte
+    std::vector<unsigned char> buf((size_t)total);
+    if (fread(buf.data(), 1, (size_t)total, f) != (size_t)total) {
+      free(out); fclose(f); return -2;
+    }
+    const float scale = normalize ? (1.0f / 255.0f) : 1.0f;
+    for (int64_t i = 0; i < total; i++) out[i] = buf[(size_t)i] * scale;
+  } else {  // 0x0D float32 big-endian
+    std::vector<unsigned char> buf((size_t)total * 4);
+    if (fread(buf.data(), 1, buf.size(), f) != buf.size()) {
+      free(out); fclose(f); return -2;
+    }
+    for (int64_t i = 0; i < total; i++) {
+      unsigned char* p = &buf[(size_t)i * 4];
+      uint32_t v = ((uint32_t)p[0] << 24) | (p[1] << 16) | (p[2] << 8) | p[3];
+      memcpy(&out[i], &v, 4);
+    }
+  }
+  fclose(f);
+  *out_ndim = ndim;
+  *out_data = out;
+  return 0;
+}
+
+void dl4j_free(void* p) { free(p); }
+
+// ---------------------------------------------------------------------------
+// Bulk CSV parsing (numeric, single delimiter) — the DataVec
+// CSVRecordReader hot path without per-cell Python objects.
+// ---------------------------------------------------------------------------
+
+// Fast fixed-notation float parse ([-]ddd[.ddd...]); defers to strtof for
+// exponents / inf / nan / overlong digit runs. strtof is locale-aware and
+// slow; CSV feeds are overwhelmingly plain fixed notation.
+static inline float fast_strtof(char* p, char** end) {
+  char* start = p;
+  bool neg = false;
+  if (*p == '-') { neg = true; p++; }
+  else if (*p == '+') { p++; }
+  uint64_t mant = 0;
+  int digits = 0, frac_digits = 0;
+  while (*p >= '0' && *p <= '9') {
+    mant = mant * 10 + (uint64_t)(*p - '0');
+    digits++;
+    p++;
+  }
+  if (*p == '.') {
+    p++;
+    while (*p >= '0' && *p <= '9') {
+      mant = mant * 10 + (uint64_t)(*p - '0');
+      digits++;
+      frac_digits++;
+      p++;
+    }
+  }
+  if (digits == 0 || digits > 17 || *p == 'e' || *p == 'E' || *p == 'n' ||
+      *p == 'N' || *p == 'i' || *p == 'I') {
+    return strtof(start, end);  // exotic form — exact library parse
+  }
+  static const double kPow10[18] = {
+      1e0, 1e1, 1e2, 1e3, 1e4, 1e5, 1e6, 1e7, 1e8, 1e9, 1e10, 1e11, 1e12,
+      1e13, 1e14, 1e15, 1e16, 1e17};
+  double v = (double)mant / kPow10[frac_digits];
+  *end = p;
+  return (float)(neg ? -v : v);
+}
+
+static int read_whole_file(const char* path, std::vector<char>* buf) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  buf->resize((size_t)size + 1);
+  if (size > 0 && fread(buf->data(), 1, (size_t)size, f) != (size_t)size) {
+    fclose(f);
+    return -2;
+  }
+  fclose(f);
+  (*buf)[(size_t)size] = '\0';
+  return 0;
+}
+
+// Single read + in-memory scan: rows/cols from the buffer, then parse into
+// a malloc'd rows*cols float32 buffer (*out_data, caller frees).
+int dl4j_csv_read(const char* path, char delim, int64_t* out_rows,
+                  int64_t* out_cols, float** out_data) {
+  std::vector<char> buf;
+  int rc = read_whole_file(path, &buf);
+  if (rc != 0) return rc;
+  // shape scan over memory
+  int64_t rows = 0, cols = 0, cur_cols = 1;
+  int line_has_data = 0;
+  for (const char* p = buf.data(); *p; p++) {
+    char c = *p;
+    if (c == '\n') {
+      if (line_has_data) {
+        if (cols == 0) cols = cur_cols;
+        else if (cur_cols != cols) return -5;  // ragged
+        rows++;
+      }
+      cur_cols = 1;
+      line_has_data = 0;
+    } else if (c == delim) {
+      cur_cols++;
+      line_has_data = 1;
+    } else if (c != '\r' && c != ' ' && c != '\t') {
+      line_has_data = 1;
+    }
+  }
+  if (line_has_data) {  // last line without trailing newline
+    if (cols == 0) cols = cur_cols;
+    else if (cur_cols != cols) return -5;
+    rows++;
+  }
+  *out_rows = rows;
+  *out_cols = cols;
+  if (rows == 0) { *out_data = nullptr; return 0; }
+  const int64_t total = rows * cols;
+  float* out = (float*)malloc(sizeof(float) * (size_t)total);
+  if (!out) return -4;
+  char* p = buf.data();
+  int64_t i = 0;
+  while (*p && i < total) {
+    while (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n' || *p == delim)
+      p++;
+    if (!*p) break;
+    char* end = nullptr;
+    out[i++] = fast_strtof(p, &end);
+    if (end == p) { free(out); return -6; }  // not a number
+    p = end;
+  }
+  if (i != total) { free(out); return -7; }
+  *out_data = out;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Deterministic shuffle — Fisher-Yates with splitmix64 (stable across
+// platforms; the reference shuffles partitions with a seeded java Random).
+// ---------------------------------------------------------------------------
+
+static inline uint64_t splitmix64(uint64_t* state) {
+  uint64_t z = (*state += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+void dl4j_shuffle_indices(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; i++) out[i] = i;
+  uint64_t st = seed;
+  for (int64_t i = n - 1; i > 0; i--) {
+    int64_t j = (int64_t)(splitmix64(&st) % (uint64_t)(i + 1));
+    int64_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+}
+
+// Gather rows: out[i, :] = src[idx[i], :] — batch assembly after shuffle.
+void dl4j_gather_rows(const float* src, const int64_t* idx, int64_t n_idx,
+                      int64_t row_len, float* out) {
+  for (int64_t i = 0; i < n_idx; i++) {
+    memcpy(out + i * row_len, src + idx[i] * row_len,
+           sizeof(float) * (size_t)row_len);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Threaded prefetch ring buffer (AsyncDataSetIterator.java:30 equivalent).
+// The producer thread assembles shuffled minibatches from an in-memory
+// float table; the consumer (Python) pops fully-formed batches.
+// ---------------------------------------------------------------------------
+
+struct Prefetcher {
+  const float* features;   // [n, f_len] borrowed
+  const float* labels;     // [n, l_len] borrowed
+  int64_t n, f_len, l_len, batch;
+  uint64_t seed;
+  int epochs;
+  size_t capacity;
+
+  std::deque<std::vector<float>> queue;  // alternating feat/label blocks
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::thread worker;
+  std::atomic<bool> done{false};
+  std::atomic<bool> stop{false};
+
+  void run() {
+    std::vector<int64_t> idx((size_t)n);
+    uint64_t st = seed;
+    for (int e = 0; e < epochs && !stop; e++) {
+      dl4j_shuffle_indices(n, splitmix64(&st), idx.data());
+      for (int64_t b = 0; b + batch <= n && !stop; b += batch) {
+        std::vector<float> fb((size_t)(batch * f_len));
+        std::vector<float> lb((size_t)(batch * l_len));
+        dl4j_gather_rows(features, idx.data() + b, batch, f_len, fb.data());
+        dl4j_gather_rows(labels, idx.data() + b, batch, l_len, lb.data());
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] { return queue.size() < capacity * 2 || stop; });
+        if (stop) return;
+        queue.emplace_back(std::move(fb));
+        queue.emplace_back(std::move(lb));
+        cv_get.notify_one();
+      }
+    }
+    {
+      // lock before flipping done: otherwise a consumer that just evaluated
+      // the wait predicate misses this notify and sleeps forever
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+    }
+    cv_get.notify_all();
+  }
+};
+
+void* dl4j_prefetch_start(const float* features, const float* labels,
+                          int64_t n, int64_t f_len, int64_t l_len,
+                          int64_t batch, int epochs, uint64_t seed,
+                          int capacity) {
+  if (batch <= 0 || n < batch) return nullptr;
+  Prefetcher* p = new Prefetcher();
+  p->features = features;
+  p->labels = labels;
+  p->n = n;
+  p->f_len = f_len;
+  p->l_len = l_len;
+  p->batch = batch;
+  p->seed = seed;
+  p->epochs = epochs;
+  p->capacity = (size_t)(capacity > 0 ? capacity : 2);
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Pops one batch into caller buffers. Returns 1 on success, 0 when the
+// stream is exhausted.
+int dl4j_prefetch_next(void* handle, float* feat_out, float* label_out) {
+  Prefetcher* p = (Prefetcher*)handle;
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_get.wait(lk, [&] { return p->queue.size() >= 2 || p->done; });
+  if (p->queue.size() < 2) return 0;
+  std::vector<float> fb = std::move(p->queue.front());
+  p->queue.pop_front();
+  std::vector<float> lb = std::move(p->queue.front());
+  p->queue.pop_front();
+  lk.unlock();
+  p->cv_put.notify_one();
+  memcpy(feat_out, fb.data(), fb.size() * sizeof(float));
+  memcpy(label_out, lb.data(), lb.size() * sizeof(float));
+  return 1;
+}
+
+void dl4j_prefetch_stop(void* handle) {
+  Prefetcher* p = (Prefetcher*)handle;
+  p->stop = true;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->cv_put.notify_all();
+    p->cv_get.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+}  // extern "C"

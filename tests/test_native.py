@@ -1,0 +1,118 @@
+"""Native host-runtime tests: parity between the C++ library and the pure
+Python fallbacks (idx/CSV parsing, deterministic shuffle, threaded prefetch
+— the nd4j-native/Canova/AsyncDataSetIterator roles, SURVEY.md L0/L5)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.native import (
+    NATIVE_AVAILABLE,
+    NativePrefetchIterator,
+    read_csv,
+    read_idx,
+    shuffle_indices,
+)
+
+
+def write_idx_bytes(path, arr: np.ndarray):
+    """idx file with unsigned-byte payload."""
+    with open(path, "wb") as f:
+        f.write(bytes([0, 0, 0x08, arr.ndim]))
+        for d in arr.shape:
+            f.write(struct.pack(">i", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+class TestIdx:
+    def test_read_idx_matches_python(self, tmp_path):
+        rng = np.random.default_rng(0)
+        arr = rng.integers(0, 256, (10, 5, 5), dtype=np.uint8)
+        p = str(tmp_path / "images.idx")
+        write_idx_bytes(p, arr)
+        out = read_idx(p, normalize=True)
+        assert out.shape == (10, 5, 5)
+        np.testing.assert_allclose(out, arr.astype(np.float32) / 255.0,
+                                   rtol=1e-6)
+        py = native._read_idx_py(p, True)
+        np.testing.assert_allclose(out, py, rtol=1e-6)
+
+    def test_read_idx_unnormalized(self, tmp_path):
+        arr = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        p = str(tmp_path / "l.idx")
+        write_idx_bytes(p, arr)
+        out = read_idx(p, normalize=False)
+        np.testing.assert_array_equal(out, arr.astype(np.float32))
+
+
+class TestCsv:
+    def test_read_csv_matches_numpy(self, tmp_path):
+        rng = np.random.default_rng(1)
+        data = rng.normal(size=(50, 7))
+        p = str(tmp_path / "d.csv")
+        np.savetxt(p, data, delimiter=",", fmt="%.6f")
+        out = read_csv(p)
+        ref = np.loadtxt(p, delimiter=",", ndmin=2).astype(np.float32)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_read_csv_no_trailing_newline(self, tmp_path):
+        p = tmp_path / "x.csv"
+        p.write_text("1.5,2.5\n3.5,4.5")  # no trailing \n
+        out = read_csv(str(p))
+        np.testing.assert_allclose(out, [[1.5, 2.5], [3.5, 4.5]])
+
+    def test_ragged_csv_rejected(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1,2\n3,4,5\n")
+        with pytest.raises(Exception):
+            read_csv(str(p))
+
+
+class TestShuffle:
+    def test_native_matches_python_fallback(self):
+        for n, seed in [(10, 0), (1000, 42), (7, 123456789)]:
+            a = shuffle_indices(n, seed)
+            b = native._shuffle_py(n, seed)
+            np.testing.assert_array_equal(a, b)
+            assert sorted(a.tolist()) == list(range(n))
+
+    def test_deterministic(self):
+        np.testing.assert_array_equal(
+            shuffle_indices(100, 7), shuffle_indices(100, 7)
+        )
+        assert not np.array_equal(shuffle_indices(100, 7),
+                                  shuffle_indices(100, 8))
+
+
+class TestPrefetch:
+    def test_prefetch_covers_all_batches_and_matches_fallback(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(64, 3, 2)).astype(np.float32)
+        y = rng.normal(size=(64, 5)).astype(np.float32)
+        it_native = NativePrefetchIterator(x, y, batch=16, epochs=2, seed=9)
+        batches = list(it_native)
+        assert len(batches) == 8  # 4 per epoch x 2 epochs
+        for fb, lb in batches:
+            assert fb.shape == (16, 3, 2) and lb.shape == (16, 5)
+        # bit-exact agreement with the pure-python path
+        py_batches = list(it_native._iter_py())
+        assert len(py_batches) == len(batches)
+        for (fa, la), (fb, lb) in zip(batches, py_batches):
+            np.testing.assert_array_equal(fa, fb)
+            np.testing.assert_array_equal(la, lb)
+
+    def test_each_epoch_is_a_permutation(self):
+        x = np.arange(32, dtype=np.float32).reshape(32, 1)
+        y = np.zeros((32, 1), np.float32)
+        seen = [fb.reshape(-1) for fb, _ in
+                NativePrefetchIterator(x, y, batch=8, epochs=1, seed=3)]
+        flat = np.concatenate(seen)
+        assert sorted(flat.tolist()) == list(range(32))
+
+
+def test_native_library_loaded():
+    """The toolchain is baked into this image, so the native path must be
+    active (the fallback exists for foreign deployments)."""
+    assert NATIVE_AVAILABLE
