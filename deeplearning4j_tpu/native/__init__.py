@@ -159,9 +159,9 @@ def read_csv(path: str, delimiter: str = ",") -> np.ndarray:
 def shuffle_indices(n: int, seed: int) -> np.ndarray:
     """Deterministic cross-platform Fisher-Yates permutation."""
     lib = _load()
-    out = np.empty((n,), np.int64)
     if lib is None:
         return _shuffle_py(n, seed)
+    out = np.empty((n,), np.int64)
     lib.dl4j_shuffle_indices(
         n, seed & 0xFFFFFFFFFFFFFFFF,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

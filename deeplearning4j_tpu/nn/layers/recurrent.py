@@ -67,11 +67,23 @@ def _lstm_step(act, params, h_prev, c_prev, xproj_t, mask_t):
     return h, c
 
 
-def _scan_lstm(act, params, x, h0, c0, mask, reverse=False):
+def _scan_lstm(act, params, x, h0, c0, mask, reverse=False, is_tanh=False):
     """x: [N,T,F] -> outputs [N,T,H], final (h,c)."""
     n, t, _ = x.shape
     n_out = h0.shape[-1]
     xproj = (x.reshape(n * t, -1) @ params["W"] + params["b"]).reshape(n, t, 4 * n_out)
+    if is_tanh and mask is None and not reverse:
+        # hot path: fused pallas kernel keeps U/h/c VMEM-resident across the
+        # whole recurrence (ops/pallas_kernels.py; cuDNN-helper role)
+        from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+        if pk.pallas_enabled() and pk.lstm_scan_fits(n, n_out, t):
+            hs, h_f, c_f = pk.lstm_pallas_scan(
+                xproj, params["U"], params["p"], h0, c0
+            )
+            # kernel computes in f32; preserve the caller's dtype contract
+            return (hs.astype(x.dtype), h_f.astype(x.dtype),
+                    c_f.astype(x.dtype))
     xproj_t = jnp.swapaxes(xproj, 0, 1)  # [T,N,4H] scan over leading axis
     mask_t = None
     if mask is not None:
@@ -117,7 +129,10 @@ class GravesLSTMImpl(BaseLayerImpl):
         else:
             h0 = jnp.zeros((n, n_out), x.dtype)
             c0 = jnp.zeros((n, n_out), x.dtype)
-        ys, h_f, c_f = _scan_lstm(self.act, params, x, h0, c0, mask)
+        ys, h_f, c_f = _scan_lstm(
+            self.act, params, x, h0, c0, mask,
+            is_tanh=(self.conf.activation or "tanh") == "tanh",
+        )
         if mask is not None:
             ys = ys * jnp.asarray(mask, ys.dtype)[..., None]
         return ys, {"h": h_f, "c": c_f}

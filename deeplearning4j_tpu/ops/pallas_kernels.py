@@ -1,0 +1,217 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference accelerates its hot layers with hand-written native kernels
+(cuDNN helpers, SURVEY.md section 2.2; LSTMHelpers.java per-step gemm loop
+:132,145). The XLA equivalent of most of that set is automatic fusion; the one
+place a hand kernel still pays on TPU is the LSTM recurrence: a lax.scan
+launches one XLA loop iteration per timestep, re-reading U/h/c from HBM each
+step. The pallas kernel below runs the WHOLE scan in one kernel — U, the
+peepholes, and the carried h/c stay resident in VMEM; only the per-step
+input projection streams in and the per-step output streams out.
+
+Scope & fallback policy:
+  - forward only; the backward pass is jax autodiff through the plain scan
+    (custom_vjp recomputes — same gradients, fwd at kernel speed);
+  - mask-free path (padded/masked sequences fall back to the scan);
+  - OPT-IN (DL4J_TPU_PALLAS=1): measured on a v5e chip (N=64, T=256,
+    H=256, f32), XLA's lax.scan already runs the recurrence at ~peak MXU
+    throughput (0.04 ms, ~215 effective TFLOP/s — the while-loop body is
+    fully pipelined and fused), while this kernel measures ~3.9 ms.
+    Verdict recorded per the project rule "let XLA fuse — don't
+    hand-schedule what the compiler already does": the kernel stays as the
+    selectable-backend pattern (the reference's reflective cuDNN-helper
+    slot, ConvolutionLayer.java:64-70) and as scaffolding for ops XLA
+    cannot fuse (future ring-attention / sparse-update kernels), not as
+    the default path.
+  - CPU tests run the same kernel under interpret=True.
+
+Written per /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# VMEM is ~16MB/core; keep a conservative budget for U + h + c + one xproj
+# block + one output block (floats).
+_VMEM_BUDGET_FLOATS = 2_000_000
+
+
+def pallas_enabled() -> bool:
+    """Opt-in only: XLA's scan outperforms the hand kernel on current TPUs
+    (see module docstring benchmark)."""
+    env = os.environ.get("DL4J_TPU_PALLAS")
+    if env is None:
+        return False
+    return env not in ("0", "false", "False") and jax.default_backend() == "tpu"
+
+
+def _time_chunk(t: int) -> int:
+    """Timesteps per grid step (amortizes pipeline overhead; must divide T)."""
+    for cand in (32, 16, 8, 4, 2):
+        if t % cand == 0:
+            return cand
+    return 1
+
+
+def lstm_scan_fits(n: int, h: int, t: int = 32) -> bool:
+    """VMEM guard for the ACTUAL block sizes the kernel uses: a ch-timestep
+    xproj block (ch*n*4h) + output block (ch*n*h), U, h/c scratch + io."""
+    ch = _time_chunk(t)
+    need = h * 4 * h + 4 * n * h + ch * n * 4 * h + ch * n * h
+    return need <= _VMEM_BUDGET_FLOATS
+
+
+# ---------------------------------------------------------------------------
+# Fused LSTM forward scan
+# ---------------------------------------------------------------------------
+
+
+def _lstm_kernel(xproj_ref, u_ref, p_ref, h0_ref, c0_ref, hs_ref, hf_ref,
+                 cf_ref, h_scr, c_scr):
+    """Grid = (T,), sequential. Time-major layout: block t sees
+    xproj[t, :, :] and writes hs[t, :, :] — the block's trailing two dims
+    are then (N, 4H)/(N, H), satisfying the TPU (8, 128) tiling rule.
+    h/c live in VMEM scratch across iterations."""
+    t = pl.program_id(0)
+    n_t = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    n_out = h_scr.shape[-1]
+    chunk = xproj_ref.shape[0]
+    u = u_ref[:]
+    pi = p_ref[0, :]
+    pf = p_ref[1, :]
+    po = p_ref[2, :]
+
+    def body(k, carry):
+        h_prev, c_prev = carry
+        # z: [N, 4H] = xproj_t + h_prev @ U  (MXU)
+        z = xproj_ref[k, :, :] + jnp.dot(
+            h_prev, u, preferred_element_type=jnp.float32
+        )
+        zi = z[:, 0 * n_out : 1 * n_out]
+        zf = z[:, 1 * n_out : 2 * n_out]
+        zo = z[:, 2 * n_out : 3 * n_out]
+        zg = z[:, 3 * n_out : 4 * n_out]
+        i = jax.nn.sigmoid(zi + pi * c_prev)
+        f = jax.nn.sigmoid(zf + pf * c_prev)
+        g = jnp.tanh(zg)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(zo + po * c)
+        h = o * jnp.tanh(c)
+        hs_ref[k, :, :] = h
+        return h, c
+
+    h, c = jax.lax.fori_loop(0, chunk, body, (h_scr[:], c_scr[:]))
+    h_scr[:] = h
+    c_scr[:] = c
+
+    @pl.when(t == n_t - 1)
+    def _():
+        hf_ref[:] = h
+        cf_ref[:] = c
+
+
+def _lstm_pallas_fwd_raw(xproj, u, p, h0, c0, *, interpret: bool):
+    """xproj: [N, T, 4H] (input projection + bias, precomputed);
+    returns (hs [N,T,H], h_f, c_f)."""
+    n, t, four_h = xproj.shape
+    h_dim = four_h // 4
+    ch = _time_chunk(t)
+    grid = (t // ch,)
+    out_shape = (
+        jax.ShapeDtypeStruct((t, n, h_dim), jnp.float32),
+        jax.ShapeDtypeStruct((n, h_dim), jnp.float32),
+        jax.ShapeDtypeStruct((n, h_dim), jnp.float32),
+    )
+    xproj_tm = jnp.swapaxes(xproj, 0, 1)  # time-major [T, N, 4H]
+    hs_tm, h_f, c_f = pl.pallas_call(
+        _lstm_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ch, n, four_h), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h_dim, four_h), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, h_dim), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((ch, n, h_dim), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((n, h_dim), jnp.float32),
+            pltpu.VMEM((n, h_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj_tm.astype(jnp.float32), u.astype(jnp.float32),
+      p.astype(jnp.float32), h0.astype(jnp.float32), c0.astype(jnp.float32))
+    return jnp.swapaxes(hs_tm, 0, 1), h_f, c_f
+
+
+def _lstm_scan_reference(xproj, u, p, h0, c0):
+    """Plain lax.scan twin of the kernel (tanh activation) — the autodiff
+    path for the custom VJP and the numerical oracle in tests."""
+
+    def step(carry, xp_t):
+        h_prev, c_prev = carry
+        z = xp_t + h_prev @ u
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(zi + p[0] * c_prev)
+        f = jax.nn.sigmoid(zf + p[1] * c_prev)
+        g = jnp.tanh(zg)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(zo + p[2] * c)
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (h_f, c_f), hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(xproj, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), h_f, c_f
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def lstm_pallas_scan(xproj, u, p, h0, c0, interpret=False):
+    """Fused LSTM forward scan: pallas kernel forward, scan-autodiff
+    backward. Gate order in the 4H axis is [i, f, o, g], identical to
+    recurrent._lstm_step's z-split, so params are shared untouched."""
+    hs, h_f, c_f = _lstm_pallas_fwd_raw(xproj, u, p, h0, c0,
+                                        interpret=interpret)
+    return hs, h_f, c_f
+
+
+def _lstm_fwd(xproj, u, p, h0, c0, interpret):
+    out = lstm_pallas_scan(xproj, u, p, h0, c0, interpret)
+    return out, (xproj, u, p, h0, c0)
+
+
+def _lstm_bwd(interpret, res, grads):
+    xproj, u, p, h0, c0 = res
+    _, vjp = jax.vjp(
+        lambda *args: _lstm_scan_reference(*args), xproj, u, p, h0, c0
+    )
+    return vjp(grads)
+
+
+lstm_pallas_scan.defvjp(_lstm_fwd, _lstm_bwd)

@@ -130,6 +130,7 @@ static int read_whole_file(const char* path, std::vector<char>* buf) {
   if (!f) return -1;
   fseek(f, 0, SEEK_END);
   long size = ftell(f);
+  if (size < 0) { fclose(f); return -1; }  // non-seekable (FIFO etc.)
   fseek(f, 0, SEEK_SET);
   buf->resize((size_t)size + 1);
   if (size > 0 && fread(buf->data(), 1, (size_t)size, f) != (size_t)size) {
@@ -315,9 +316,12 @@ int dl4j_prefetch_next(void* handle, float* feat_out, float* label_out) {
 
 void dl4j_prefetch_stop(void* handle) {
   Prefetcher* p = (Prefetcher*)handle;
-  p->stop = true;
   {
+    // done must flip too: a consumer blocked in dl4j_prefetch_next would
+    // otherwise re-sleep after the notify and later touch a freed mutex
     std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->done = true;
     p->cv_put.notify_all();
     p->cv_get.notify_all();
   }
