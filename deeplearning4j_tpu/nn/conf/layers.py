@@ -316,3 +316,24 @@ class GravesBidirectionalLSTM(FeedForwardLayer):
 class GRU(FeedForwardLayer):
     """Gated recurrent unit (reference: nn/conf/layers/GRU.java; runtime
     nn/layers/recurrent/GRU.java, 399 LoC)."""
+
+
+@register_layer
+@dataclass
+class MultiHeadAttention(FeedForwardLayer):
+    """Multi-head self-attention over [N, T, F] sequences.
+
+    Beyond-reference capability (the reference's only long-sequence tool is
+    truncated BPTT — SURVEY.md section 5): pairs with the framework's ring
+    attention (parallel/sequence_parallel.py) so sequences shard over the
+    mesh's 'seq' axis and attention stays exact at any length.
+    n_out is the model width; head_dim = n_out // num_heads."""
+
+    num_heads: int = 4
+    causal: bool = False
+
+    def __post_init__(self):
+        if self.n_out and self.num_heads and self.n_out % self.num_heads:
+            raise ValueError(
+                f"n_out={self.n_out} not divisible by num_heads={self.num_heads}"
+            )

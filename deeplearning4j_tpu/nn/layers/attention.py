@@ -1,0 +1,83 @@
+"""Multi-head self-attention layer runtime.
+
+Beyond-reference layer (SURVEY.md section 5 notes the reference's only
+long-sequence mechanism is truncated BPTT): functional MHA over [N, T, F]
+activations, with the math shared with the sequence-parallel ring-attention
+path (parallel/sequence_parallel.py) — single-device here, sharded exact
+attention when driven through ring_attention_sharded.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import BaseLayerImpl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.parallel.sequence_parallel import (
+    mha_apply,
+    multi_head_attention,
+)
+
+
+class MultiHeadAttentionImpl(BaseLayerImpl):
+    def initialize(self, key, input_shape):
+        t, f = input_shape
+        conf = self.conf
+        n_in = conf.n_in or f
+        n_out = conf.n_out or n_in
+        head_dim = n_out // conf.num_heads
+
+        def w(k, shape):
+            return init_weights(
+                k, shape, conf.weight_init or "xavier", shape[0], shape[1],
+                conf.dist,
+            )
+
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        proj = conf.num_heads * head_dim
+        params = {
+            "Wq": w(k1, (n_in, proj)),
+            "Wk": w(k2, (n_in, proj)),
+            "Wv": w(k3, (n_in, proj)),
+            "Wo": w(k4, (proj, n_out)),
+            "b": jnp.zeros((n_out,), jnp.float32),
+        }
+        return params, {}, (t, n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              carry_state=False):
+        x = self._dropout_in(x, train, rng)
+        y = mha_apply(
+            {k: params[k] for k in ("Wq", "Wk", "Wv", "Wo")},
+            x,
+            self.conf.num_heads,
+            causal=self.conf.causal,
+            key_mask=mask,  # padded timesteps excluded from the softmax
+        ) + params["b"]
+        y = self.act(y)
+        if mask is not None:
+            y = y * jnp.asarray(mask, y.dtype)[..., None]
+        return y, state
+
+    def step(self, params, state, x_t):
+        """Streaming single-step inference (rnnTimeStep) with a KV cache:
+        the attention analog of carried LSTM state. x_t: [N, F]."""
+        conf = self.conf
+        n = x_t.shape[0]
+        proj = params["Wq"].shape[1]
+        head_dim = proj // conf.num_heads
+
+        def split(w):
+            return (x_t @ w).reshape(n, 1, conf.num_heads, head_dim)
+
+        q, k_new, v_new = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        k_cache = state.get("k_cache")
+        if k_cache is None or k_cache.shape[0] != n:
+            k, v = k_new, v_new
+        else:
+            k = jnp.concatenate([k_cache, k_new], axis=1)
+            v = jnp.concatenate([state["v_cache"], v_new], axis=1)
+        att = multi_head_attention(q, k, v, causal=False)  # all cache visible
+        y = att.reshape(n, proj) @ params["Wo"] + params["b"]
+        return self.act(y), {"k_cache": k, "v_cache": v}

@@ -33,6 +33,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     GravesBidirectionalLSTMImpl,
     GravesLSTMImpl,
 )
+from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttentionImpl
 
 FACTORY = {
     conf_layers.DenseLayer: DenseLayerImpl,
@@ -49,6 +50,7 @@ FACTORY = {
     conf_layers.GravesLSTM: GravesLSTMImpl,
     conf_layers.GravesBidirectionalLSTM: GravesBidirectionalLSTMImpl,
     conf_layers.GRU: GRUImpl,
+    conf_layers.MultiHeadAttention: MultiHeadAttentionImpl,
 }
 
 # recurrent layers with carryable state (TBPTT chaining / rnnTimeStep)
@@ -64,6 +66,7 @@ RNN_CONFS = (
     conf_layers.GravesBidirectionalLSTM,
     conf_layers.GRU,
     conf_layers.RnnOutputLayer,
+    conf_layers.MultiHeadAttention,  # consumes/produces [N, T, F]
 )
 CNN_CONFS = (
     conf_layers.ConvolutionLayer,

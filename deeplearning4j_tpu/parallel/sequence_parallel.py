@@ -1,0 +1,166 @@
+"""Sequence/context parallelism: ring attention over the device mesh.
+
+The reference's only long-sequence mechanism is truncated BPTT (SURVEY.md
+section 5 "Long-context": no ring attention / CP / Ulysses existed in 2016).
+This framework treats long-context as first-class: sequences too long for
+one chip's HBM are sharded over the mesh's sequence axis and attention runs
+as a RING — each device holds its Q shard permanently, while K/V shards
+rotate around the ring via `ppermute` over ICI; softmax is accumulated
+online (running max + denominator, flash-attention style) so the result is
+EXACTLY full attention, never an approximation.
+
+Pieces:
+  - `multi_head_attention(...)`: the single-device reference math;
+  - `ring_attention(...)`: per-shard body (runs inside shard_map);
+  - `ring_attention_sharded(...)`: user entry — builds the shard_map over a
+    ('seq',) mesh axis and returns the full attention output;
+  - causal masking is exact across shards via global position indexing.
+
+Design notes (scaling-book recipe): the ring overlaps compute of block t
+with the DCN/ICI transfer of block t+1 when XLA schedules the ppermute
+asynchronously; per-device memory is O(T_local * T_local) per block pair
+instead of O(T^2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+SEQ_AXIS = "seq"
+
+
+# ---------------------------------------------------------------------------
+# Reference single-device attention
+# ---------------------------------------------------------------------------
+
+
+def multi_head_attention(q, k, v, *, causal: bool = False,
+                         q_offset: int = 0, k_offset: int = 0,
+                         key_mask=None):
+    """q,k,v: [N, T, H, D] -> [N, T, H, D]; plain softmax attention.
+    Offsets give global positions for causal masking of shards.
+    key_mask: optional [N, Tk] 0/1 — padded keys are excluded from the
+    softmax (variable-length batches)."""
+    d = q.shape[-1]
+    s = jnp.einsum("nqhd,nkhd->nhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    if causal:
+        qi = q_offset + jnp.arange(q.shape[1])
+        ki = k_offset + jnp.arange(k.shape[1])
+        mask = qi[:, None] >= ki[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    if key_mask is not None:
+        km = jnp.asarray(key_mask, bool)[:, None, None, :]  # [N,1,1,Tk]
+        s = jnp.where(km, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (causal shard with no visible keys) -> zeros not NaN
+    p = jnp.where(jnp.isfinite(s).any(axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("nhqk,nkhd->nqhd", p, v)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (runs inside shard_map over the sequence axis)
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_body(q, k, v, *, causal: bool, t_local: int,
+                         axis_name: str = SEQ_AXIS):
+    """Per-device body. q,k,v: [N, T_local, H, D] shards. Exact full
+    attention via online softmax over rotating K/V blocks."""
+    n_dev = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    n, tq, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    q32 = q.astype(jnp.float32)
+
+    # accumulators: running max m, denominator l, numerator o
+    m = jnp.full((n, h, tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((n, h, tq), jnp.float32)
+    o = jnp.zeros((n, tq, h, d), jnp.float32)
+
+    q_pos = my * t_local + jnp.arange(tq)
+
+    def step_fn(carry, step):
+        m, l, o, k_blk, v_blk = carry
+        # the block currently held arrived from device (my - step) mod n_dev
+        src = (my - step) % n_dev
+        s = jnp.einsum("nqhd,nkhd->nhqk", q32, k_blk.astype(jnp.float32))
+        s = s * scale
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)  # [N,H,Tq]
+        m_new = jnp.maximum(m, blk_max)
+        # guard -inf - -inf
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * corr + p.sum(axis=-1)
+        o = o * jnp.moveaxis(corr, 1, 2)[..., None] + jnp.einsum(
+            "nhqk,nkhd->nqhd", p, v_blk.astype(jnp.float32)
+        )
+        # rotate K/V one step around the ring
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (m_new, l, o, k_blk, v_blk), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        step_fn, (m, l, o, k, v), jnp.arange(n_dev)
+    )
+    denom = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False):
+    """Full exact attention with the SEQUENCE dimension sharded over
+    mesh axis 'seq'. q,k,v: [N, T, H, D] with T divisible by the axis size."""
+    n_dev = mesh.shape[SEQ_AXIS]
+    t = q.shape[1]
+    if t % n_dev != 0:
+        raise ValueError(f"sequence length {t} not divisible by {n_dev} devices")
+    t_local = t // n_dev
+    spec = P(None, SEQ_AXIS, None, None)
+    fn = shard_map(
+        partial(_ring_attention_body, causal=causal, t_local=t_local),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Layer-zoo integration: MultiHeadAttention for [N, T, F] activations
+# ---------------------------------------------------------------------------
+
+
+def mha_apply(params, x, num_heads: int, *, causal: bool = False,
+              mesh: Optional[Mesh] = None, key_mask=None):
+    """x: [N, T, F] -> [N, T, F]; runs ring attention when a mesh with a
+    'seq' axis is supplied, single-device attention otherwise. key_mask
+    ([N, T] 0/1) excludes padded timesteps from attention (single-device
+    path; the ring path shards full sequences)."""
+    n, t, f = x.shape
+    proj = params["Wq"].shape[1]
+    head_dim = proj // num_heads
+
+    def split(w):
+        return (x @ w).reshape(n, t, num_heads, head_dim)
+
+    q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+    if mesh is not None and SEQ_AXIS in mesh.shape:
+        att = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    else:
+        att = multi_head_attention(q, k, v, causal=causal, key_mask=key_mask)
+    return att.reshape(n, t, proj) @ params["Wo"]

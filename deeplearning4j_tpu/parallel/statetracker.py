@@ -1,0 +1,255 @@
+"""Job distribution + state tracking (legacy scaleout stack parity).
+
+Capability mirror of the reference's second distributed backend (SURVEY.md
+sections 2.4, 5): the Akka/Hazelcast/ZooKeeper plane —
+  - StateTracker (deeplearning4j-scaleout-api/.../statetracker/
+    StateTracker.java: job queue, parameter storage, worker heartbeats,
+    job reclaim on dead workers);
+  - work routers (deeplearning4j-scaleout-akka/.../workrouter/: HogWild —
+    async lock-free dispatch — vs IterativeReduce — barrier rounds with
+    aggregation);
+  - service discovery (zookeeper ZooKeeperConfigurationRegister/Retriever —
+    registering the master address + conf for workers to find).
+
+TPU-native reading: in a single-controller TPU pod these roles collapse
+into process-local coordination (the controller IS the master), so the
+implementation is an in-process, thread-safe tracker with REAL heartbeat
+expiry + job-reclaim semantics (the failure-detection behavior the
+reference gets from Hazelcast), and a file-based registry standing in for
+znodes. Multi-controller deployments point the registry at a shared
+filesystem and the semantics carry over.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class Job:
+    """Reference scaleout/api/Job.java: work id + payload (+ worker)."""
+
+    job_id: str
+    payload: Any
+    worker_id: Optional[str] = None
+    attempts: int = 0
+    done: bool = False
+    result: Any = None
+
+
+class StateTracker:
+    """In-process job queue + heartbeats + reclaim
+    (BaseHazelCastStateTracker.java:49 capability surface)."""
+
+    def __init__(self, heartbeat_timeout: float = 5.0):
+        self.heartbeat_timeout = heartbeat_timeout
+        self._lock = threading.Lock()
+        self._pending: List[Job] = []
+        self._assigned: Dict[str, Job] = {}  # job_id -> job
+        self._done: Dict[str, Job] = {}
+        self._heartbeats: Dict[str, float] = {}
+        self._params: Dict[str, Any] = {}  # replicated-map role
+
+    # -- job lifecycle ----------------------------------------------------
+    def add_job(self, job: Job) -> None:
+        with self._lock:
+            self._pending.append(job)
+
+    def request_job(self, worker_id: str) -> Optional[Job]:
+        """Worker asks for work (GiveMeMyJob protocol message)."""
+        with self._lock:
+            self._heartbeats[worker_id] = time.monotonic()
+            if not self._pending:
+                return None
+            job = self._pending.pop(0)
+            job.worker_id = worker_id
+            job.attempts += 1
+            self._assigned[job.job_id] = job
+            return job
+
+    def complete_job(self, job_id: str, result: Any = None) -> None:
+        with self._lock:
+            job = self._assigned.pop(job_id, None)
+            if job is None:
+                return
+            job.done = True
+            job.result = result
+            self._done[job_id] = job
+
+    def fail_job(self, job_id: str) -> None:
+        """JobFailed message: back to the queue."""
+        with self._lock:
+            job = self._assigned.pop(job_id, None)
+            if job is not None:
+                job.worker_id = None
+                self._pending.append(job)
+
+    # -- heartbeats / failure detection -----------------------------------
+    def heartbeat(self, worker_id: str) -> None:
+        with self._lock:
+            self._heartbeats[worker_id] = time.monotonic()
+
+    def dead_workers(self) -> List[str]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                w for w, t in self._heartbeats.items()
+                if now - t > self.heartbeat_timeout
+            ]
+
+    def reclaim_dead_jobs(self) -> int:
+        """Re-queue jobs assigned to workers that stopped heartbeating
+        (the ClearWorker/job-reclaim protocol)."""
+        dead = set(self.dead_workers())
+        reclaimed = 0
+        with self._lock:
+            for job_id in list(self._assigned):
+                job = self._assigned[job_id]
+                if job.worker_id in dead:
+                    del self._assigned[job_id]
+                    job.worker_id = None
+                    self._pending.append(job)
+                    reclaimed += 1
+            for w in dead:
+                self._heartbeats.pop(w, None)
+        return reclaimed
+
+    # -- shared parameter storage (replicated-map role) --------------------
+    def set_params(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._params[key] = value
+
+    def get_params(self, key: str) -> Any:
+        with self._lock:
+            return self._params.get(key)
+
+    # -- introspection ----------------------------------------------------
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "pending": len(self._pending),
+                "assigned": len(self._assigned),
+                "done": len(self._done),
+            }
+
+    def results(self) -> Dict[str, Any]:
+        with self._lock:
+            return {k: j.result for k, j in self._done.items()}
+
+    def drain_results(self) -> Dict[str, Any]:
+        """Snapshot AND clear completed jobs (per-round aggregation must not
+        see previous rounds' results)."""
+        with self._lock:
+            out = {k: j.result for k, j in self._done.items()}
+            self._done.clear()
+            return out
+
+
+# ---------------------------------------------------------------------------
+# Work routers
+# ---------------------------------------------------------------------------
+
+
+class HogwildWorkRouter:
+    """Async dispatch, no synchronization between workers
+    (HogWildWorkRouter.java): every idle worker immediately gets the next
+    job; results apply in completion order."""
+
+    def __init__(self, tracker: StateTracker, num_workers: int):
+        self.tracker = tracker
+        self.num_workers = num_workers
+
+    def run(self, work_fn: Callable[[Any], Any]) -> Dict[str, Any]:
+        def worker(wid: str):
+            while True:
+                job = self.tracker.request_job(wid)
+                if job is None:
+                    return
+                try:
+                    result = work_fn(job.payload)
+                    self.tracker.complete_job(job.job_id, result)
+                except Exception:  # noqa: BLE001 — JobFailed protocol
+                    if job.attempts >= 3:
+                        # poison job: record as done-with-None while still
+                        # assigned, so it can't cycle forever
+                        self.tracker.complete_job(job.job_id, None)
+                    else:
+                        self.tracker.fail_job(job.job_id)
+
+        threads = [
+            threading.Thread(target=worker, args=(f"worker-{i}",), daemon=True)
+            for i in range(self.num_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return self.tracker.results()
+
+
+class IterativeReduceWorkRouter:
+    """Barrier rounds with aggregation (IterativeReduceWorkRouter.java):
+    all workers finish the round, then `reduce_fn` merges results before
+    the next round starts."""
+
+    def __init__(self, tracker: StateTracker, num_workers: int):
+        self.tracker = tracker
+        self.num_workers = num_workers
+
+    def run_round(self, work_fn: Callable[[Any], Any],
+                  reduce_fn: Callable[[List[Any]], Any]) -> Any:
+        HogwildWorkRouter(self.tracker, self.num_workers).run(work_fn)
+        round_results = self.tracker.drain_results()  # this round only
+        results = [r for r in round_results.values() if r is not None]
+        merged = reduce_fn(results)
+        self.tracker.set_params("merged", merged)
+        return merged
+
+
+# ---------------------------------------------------------------------------
+# Service registry (zookeeper role)
+# ---------------------------------------------------------------------------
+
+
+class FileServiceRegistry:
+    """Register/retrieve service addresses + configs through a shared
+    directory (ZooKeeperConfigurationRegister/Retriever role: the znode is
+    a json file; multi-host deployments point this at NFS/GCS-fuse)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, f"{name}.json")
+
+    def register(self, name: str, value: Dict[str, Any]) -> None:
+        tmp = self._path(name) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(value, f)
+        os.replace(tmp, self._path(name))  # atomic publish
+
+    def retrieve(self, name: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._path(name), "r", encoding="utf-8") as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def unregister(self, name: str) -> None:
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            pass
+
+    def list_services(self) -> List[str]:
+        return sorted(
+            os.path.splitext(n)[0]
+            for n in os.listdir(self.root)
+            if n.endswith(".json")
+        )

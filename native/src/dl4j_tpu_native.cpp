@@ -19,6 +19,7 @@
 #include <condition_variable>
 #include <deque>
 #include <mutex>
+#include <chrono>
 #include <thread>
 #include <vector>
 
@@ -248,6 +249,7 @@ struct Prefetcher {
   std::thread worker;
   std::atomic<bool> done{false};
   std::atomic<bool> stop{false};
+  std::atomic<int> consumers{0};  // threads inside dl4j_prefetch_next
 
   void run() {
     std::vector<int64_t> idx((size_t)n);
@@ -300,18 +302,25 @@ void* dl4j_prefetch_start(const float* features, const float* labels,
 // stream is exhausted.
 int dl4j_prefetch_next(void* handle, float* feat_out, float* label_out) {
   Prefetcher* p = (Prefetcher*)handle;
-  std::unique_lock<std::mutex> lk(p->mu);
-  p->cv_get.wait(lk, [&] { return p->queue.size() >= 2 || p->done; });
-  if (p->queue.size() < 2) return 0;
-  std::vector<float> fb = std::move(p->queue.front());
-  p->queue.pop_front();
-  std::vector<float> lb = std::move(p->queue.front());
-  p->queue.pop_front();
-  lk.unlock();
-  p->cv_put.notify_one();
-  memcpy(feat_out, fb.data(), fb.size() * sizeof(float));
-  memcpy(label_out, lb.data(), lb.size() * sizeof(float));
-  return 1;
+  p->consumers.fetch_add(1);
+  int ret = 0;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_get.wait(lk, [&] { return p->queue.size() >= 2 || p->done; });
+    if (p->queue.size() >= 2) {
+      std::vector<float> fb = std::move(p->queue.front());
+      p->queue.pop_front();
+      std::vector<float> lb = std::move(p->queue.front());
+      p->queue.pop_front();
+      lk.unlock();
+      p->cv_put.notify_one();
+      memcpy(feat_out, fb.data(), fb.size() * sizeof(float));
+      memcpy(label_out, lb.data(), lb.size() * sizeof(float));
+      ret = 1;
+    }
+  }
+  p->consumers.fetch_sub(1);
+  return ret;
 }
 
 void dl4j_prefetch_stop(void* handle) {
@@ -326,6 +335,12 @@ void dl4j_prefetch_stop(void* handle) {
     p->cv_get.notify_all();
   }
   if (p->worker.joinable()) p->worker.join();
+  // drain concurrent consumers: done is set, so any thread inside
+  // dl4j_prefetch_next wakes and exits promptly; deleting while one is
+  // still unwinding off the condvar would destroy a mutex in use
+  while (p->consumers.load() != 0) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
   delete p;
 }
 

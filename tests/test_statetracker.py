@@ -1,0 +1,125 @@
+"""StateTracker / work-router / registry tests — mirrors the reference's
+in-process actor tests (WorkerActorTest, TestDistributed) and the
+heartbeat/job-reclaim semantics of the Hazelcast StateTracker."""
+
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.parallel.statetracker import (
+    FileServiceRegistry,
+    HogwildWorkRouter,
+    IterativeReduceWorkRouter,
+    Job,
+    StateTracker,
+)
+
+
+class TestStateTracker:
+    def test_job_lifecycle(self):
+        t = StateTracker()
+        t.add_job(Job("j1", payload=41))
+        job = t.request_job("w0")
+        assert job.job_id == "j1" and job.worker_id == "w0"
+        t.complete_job("j1", result=42)
+        assert t.counts() == {"pending": 0, "assigned": 0, "done": 1}
+        assert t.results()["j1"] == 42
+
+    def test_failed_job_requeued(self):
+        t = StateTracker()
+        t.add_job(Job("j1", payload=1))
+        t.request_job("w0")
+        t.fail_job("j1")
+        assert t.counts()["pending"] == 1
+        job = t.request_job("w1")
+        assert job.attempts == 2
+
+    def test_heartbeat_expiry_and_reclaim(self):
+        t = StateTracker(heartbeat_timeout=0.05)
+        t.add_job(Job("j1", payload=1))
+        t.request_job("w0")  # w0 takes the job and then dies
+        time.sleep(0.12)
+        assert "w0" in t.dead_workers()
+        assert t.reclaim_dead_jobs() == 1
+        assert t.counts()["pending"] == 1
+        # a live worker keeps its job
+        t.add_job(Job("j2", payload=2))
+        t.request_job("w1")
+        t.heartbeat("w1")
+        assert t.reclaim_dead_jobs() == 0 or "w1" not in t.dead_workers()
+
+    def test_param_storage(self):
+        t = StateTracker()
+        t.set_params("model", [1.0, 2.0])
+        assert t.get_params("model") == [1.0, 2.0]
+
+
+class TestRouters:
+    def test_hogwild_processes_all_jobs(self):
+        t = StateTracker()
+        for i in range(20):
+            t.add_job(Job(f"j{i}", payload=i))
+        results = HogwildWorkRouter(t, num_workers=4).run(lambda x: x * x)
+        assert len(results) == 20
+        assert results["j7"] == 49
+
+    def test_hogwild_retries_then_gives_up(self):
+        t = StateTracker()
+        t.add_job(Job("bad", payload=-1))
+        calls = []
+
+        def work(x):
+            calls.append(x)
+            raise RuntimeError("boom")
+
+        results = HogwildWorkRouter(t, num_workers=1).run(work)
+        assert len(calls) == 3  # 3 attempts
+        assert "bad" in results and results["bad"] is None  # recorded poison
+        assert t.counts()["pending"] == 0  # never re-queued after give-up
+
+    def test_poison_job_does_not_starve_good_jobs(self):
+        t = StateTracker()
+        t.add_job(Job("bad", payload=-1))
+        for i in range(10):
+            t.add_job(Job(f"g{i}", payload=i))
+
+        def work(x):
+            if x < 0:
+                raise RuntimeError("boom")
+            return x
+
+        results = HogwildWorkRouter(t, num_workers=2).run(work)
+        assert sum(1 for k in results if k.startswith("g")) == 10
+
+    def test_iterative_reduce_rounds_do_not_leak(self):
+        t = StateTracker()
+        router = IterativeReduceWorkRouter(t, num_workers=2)
+        for i in range(4):
+            t.add_job(Job(f"a{i}", payload=1.0))
+        r1 = router.run_round(lambda x: x, lambda rs: sum(rs))
+        assert r1 == 4.0
+        for i in range(4):
+            t.add_job(Job(f"b{i}", payload=2.0))
+        r2 = router.run_round(lambda x: x, lambda rs: sum(rs))
+        assert r2 == 8.0  # round 1 results must not leak in
+
+    def test_iterative_reduce_round(self):
+        t = StateTracker()
+        for i in range(8):
+            t.add_job(Job(f"j{i}", payload=float(i)))
+        merged = IterativeReduceWorkRouter(t, num_workers=4).run_round(
+            lambda x: x + 1.0, lambda rs: sum(rs) / len(rs)
+        )
+        assert merged == pytest.approx(sum(range(1, 9)) / 8)
+        assert t.get_params("merged") == merged
+
+
+class TestRegistry:
+    def test_register_retrieve_roundtrip(self, tmp_path):
+        reg = FileServiceRegistry(str(tmp_path))
+        reg.register("master", {"host": "10.0.0.1", "port": 9000})
+        assert reg.retrieve("master")["port"] == 9000
+        assert reg.list_services() == ["master"]
+        reg.unregister("master")
+        assert reg.retrieve("master") is None
