@@ -132,7 +132,11 @@ class CSVSequenceRecordReader(SequenceRecordReader):
 # ---------------------------------------------------------------------------
 
 
-def _to_float(record: Sequence) -> List[float]:
+def _to_float(record: Sequence):
+    """Record values as floats; ndarray records (e.g. ImageRecordReader
+    pixel rows) pass through without a per-element Python loop."""
+    if isinstance(record, np.ndarray):
+        return record.astype(np.float32, copy=False)
     return [float(v) for v in record]
 
 
@@ -162,16 +166,27 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
     def _split(self, record: List) -> Tuple[List[float], Optional[np.ndarray]]:
         vals = _to_float(record)
+        is_arr = isinstance(vals, np.ndarray)
         li = self.label_index
         if li is None:
             return vals, None
+        if li < 0:
+            li = len(vals) + li
         if self.label_index_to is not None:  # multi-column regression label
             hi = self.label_index_to + 1
             label = np.asarray(vals[li:hi], np.float32)
-            feats = vals[:li] + vals[hi:]
+            feats = (
+                np.concatenate([vals[:li], vals[hi:]])
+                if is_arr
+                else vals[:li] + vals[hi:]
+            )
             return feats, label
         label_val = vals[li]
-        feats = vals[:li] + vals[li + 1 :]
+        feats = (
+            np.concatenate([vals[:li], vals[li + 1 :]])
+            if is_arr
+            else vals[:li] + vals[li + 1 :]
+        )
         if self.regression or self.num_possible_labels <= 0:
             return feats, np.asarray([label_val], np.float32)
         one_hot = np.zeros((self.num_possible_labels,), np.float32)

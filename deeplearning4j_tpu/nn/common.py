@@ -1,0 +1,26 @@
+"""Helpers shared by the two network containers (MultiLayerNetwork and
+ComputationGraph) so policy logic lives in one place."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def tbptt_backprop_window(conf) -> Optional[int]:
+    """In-window TBPTT backward truncation length, or None when
+    back >= fwd (reference distinct tbpttFwdLength/tbpttBackLength,
+    MultiLayerConfiguration.java:55-56; consumed by
+    LSTMHelpers.backpropGradientHelper:255)."""
+    back = conf.tbptt_back_length
+    if back and back < conf.tbptt_fwd_length:
+        return back
+    return None
+
+
+def decay_lr_scale_entry(state, rate: float):
+    """One updater-state entry with its 'lr_scale' (the cumulative 'score'
+    LR-policy decay, reference Model.applyLearningRateScoreDecay) multiplied
+    by `rate`; entries without the key pass through unchanged."""
+    if isinstance(state, dict) and "lr_scale" in state:
+        return {**state, "lr_scale": state["lr_scale"] * rate}
+    return state

@@ -322,6 +322,18 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    # YAML round-trip (reference NeuralNetConfiguration.java:285-345)
+    def to_yaml(self) -> str:
+        import yaml
+
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+
 
 # ---------------------------------------------------------------------------
 # GraphBuilder

@@ -238,6 +238,7 @@ class ComputationGraph:
         rng=None,
         masks: Optional[Dict[str, jax.Array]] = None,
         carry_state: bool = False,
+        backprop_window: Optional[int] = None,
     ):
         """Forward all vertices in topo order. Returns (activations dict
         name->array incl. inputs, new states dict).
@@ -266,6 +267,10 @@ class ComputationGraph:
                 kwargs = {}
                 if carry_state and isinstance(v, STATEFUL_RNN_CONFS):
                     kwargs["carry_state"] = True
+                if backprop_window is not None and isinstance(
+                    v, STATEFUL_RNN_CONFS
+                ):
+                    kwargs["backprop_window"] = backprop_window
                 y, ns = layer.apply(
                     params[name],
                     states[name],
@@ -315,6 +320,7 @@ class ComputationGraph:
         masks=None,
         label_masks: Optional[List] = None,
         carry_state: bool = False,
+        backprop_window: Optional[int] = None,
     ):
         """Sum of output-layer losses (reference computeGradientAndScore
         :894-907 sums per-output scores) + regularization."""
@@ -330,6 +336,7 @@ class ComputationGraph:
             rng=rng,
             masks=masks,
             carry_state=carry_state,
+            backprop_window=backprop_window,
         )
         # mask propagated to each output vertex's input (label-mask fallback,
         # mirroring MLN: lmask = label_mask if set else feature mask)
@@ -381,8 +388,10 @@ class ComputationGraph:
             new_state[n] = s
         return updates, new_state
 
-    def _get_train_step(self, n_labels: int, has_label_masks: bool, carry_state=False):
-        key = ("train_step", n_labels, has_label_masks, carry_state)
+    def _get_train_step(self, n_labels: int, has_label_masks: bool,
+                        carry_state=False, backprop_window=None):
+        key = ("train_step", n_labels, has_label_masks, carry_state,
+               backprop_window)
         if key in self._jit_cache:
             return self._jit_cache[key]
 
@@ -400,6 +409,7 @@ class ComputationGraph:
                     masks=masks,
                     label_masks=label_masks,
                     carry_state=carry_state,
+                    backprop_window=backprop_window,
                 )
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -488,36 +498,40 @@ class ComputationGraph:
                     for k in self.states[n]
                 }
 
-    def _fit_tbptt(self, inputs, labels_l, masks_d, lmasks) -> float:
+    def _fit_tbptt(self, inputs, labels_l, masks_d, lmasks,
+                   state_placer=None) -> float:
         """Truncated BPTT over a DAG (reference ComputationGraph supports
         BackpropType.TruncatedBPTT the same way MLN does :1162-1233): slice
         the time axis into fwd-length windows, carry recurrent state across
         windows (stop-gradient at the boundary — state enters the next jitted
         step as data).
 
-        Like MLN's _fit_tbptt, the backprop window equals the forward window
-        (tbptt_back_length beyond the window is not separately truncated — a
-        warning is emitted when the two differ)."""
+        A shorter tbptt_back_length truncates the backward pass inside each
+        window via stop-gradient segments (reference
+        LSTMHelpers.backpropGradientHelper:255)."""
         seq_inputs = {k: v for k, v in inputs.items() if v.ndim == 3}
         if not seq_inputs:
             raise ValueError(
                 "backprop_type='truncated_bptt' requires at least one "
                 "time-series ([B,T,F]) input"
             )
-        if self.conf.tbptt_back_length != self.conf.tbptt_fwd_length:
-            import warnings
-
-            warnings.warn(
-                "tbptt_back_length != tbptt_fwd_length: gradients are "
-                "truncated at the forward-window boundary (back length "
-                "ignored)", stacklevel=3,
-            )
         first_seq = next(iter(seq_inputs.values()))
         t_total = first_seq.shape[1]
         w = self.conf.tbptt_fwd_length
         batch_n = first_seq.shape[0]
         self._reset_rnn_states(batch_n)
-        step = self._get_train_step(len(labels_l), lmasks is not None, carry_state=True)
+        if state_placer is not None:
+            # DP path: place the freshly reset stream state on the mesh's
+            # data axis before the first window step (avoids a replicated
+            # full-batch state + GSPMD reshard)
+            state_placer()
+        from deeplearning4j_tpu.nn.common import tbptt_backprop_window
+
+        bw = tbptt_backprop_window(self.conf)
+        step = self._get_train_step(
+            len(labels_l), lmasks is not None, carry_state=True,
+            backprop_window=bw,
+        )
         loss = float("nan")
         for window_start in range(0, t_total, w):
             sl = slice(window_start, min(window_start + w, t_total))
@@ -711,12 +725,36 @@ class ComputationGraph:
                         k: jnp.zeros((batch_n, lc.n_out), jnp.float32)
                         for k in (st or {"h": None, "c": None})
                     }
-        acts, new_states = self._forward(
-            self.params, self.states, inputs, train=False, carry_state=True
+        key = ("rnn_step",)
+        if key not in self._jit_cache:
+
+            def step_fn(params, states, inputs):
+                acts, new_states = self._forward(
+                    params, states, inputs, train=False, carry_state=True
+                )
+                outs = [acts[o] for o in self.conf.outputs]
+                return [
+                    o[:, -1, :] if o.ndim == 3 else o for o in outs
+                ], new_states
+
+            self._jit_cache[key] = jax.jit(step_fn)
+        outs, self.states = self._jit_cache[key](
+            self.params, self.states, inputs
         )
-        self.states = new_states
-        outs = [acts[o] for o in self.conf.outputs]
-        return [o[:, -1, :] if o.ndim == 3 else o for o in outs]
+        return outs
+
+    def apply_lr_score_decay(self) -> None:
+        """See MultiLayerNetwork.apply_lr_score_decay (reference
+        Model.applyLearningRateScoreDecay for the 'score' LR policy)."""
+        from deeplearning4j_tpu.nn.common import decay_lr_scale_entry
+
+        rate = getattr(self.conf, "lr_policy_decay_rate", None)
+        if rate is None:
+            return
+        self.updater_state = {
+            n: decay_lr_scale_entry(s, rate)
+            for n, s in self.updater_state.items()
+        }
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

@@ -67,9 +67,31 @@ def _lstm_step(act, params, h_prev, c_prev, xproj_t, mask_t):
     return h, c
 
 
-def _scan_lstm(act, params, x, h0, c0, mask, reverse=False, is_tanh=False):
-    """x: [N,T,F] -> outputs [N,T,H], final (h,c)."""
+def _scan_lstm(act, params, x, h0, c0, mask, reverse=False, is_tanh=False,
+               backprop_window=None):
+    """x: [N,T,F] -> outputs [N,T,H], final (h,c).
+
+    backprop_window=B < T reproduces the reference's distinct TBPTT back
+    length (LSTMHelpers.backpropGradientHelper:219,255 — the backward loop
+    stops at endIdx = T - B, accumulating weight gradients and emitting
+    epsilon only for the last B steps): the first T-B steps run under
+    stop_gradient (values flow, gradients don't), the last B normally.
+    """
     n, t, _ = x.shape
+    if backprop_window is not None and 0 < backprop_window < t and not reverse:
+        cut = t - backprop_window
+        m_e = mask[:, :cut] if mask is not None else None
+        m_l = mask[:, cut:] if mask is not None else None
+        ys_e, h_m, c_m = _scan_lstm(
+            act, params, x[:, :cut], h0, c0, m_e, is_tanh=is_tanh
+        )
+        ys_e = lax.stop_gradient(ys_e)
+        h_m = lax.stop_gradient(h_m)
+        c_m = lax.stop_gradient(c_m)
+        ys_l, h_f, c_f = _scan_lstm(
+            act, params, x[:, cut:], h_m, c_m, m_l, is_tanh=is_tanh
+        )
+        return jnp.concatenate([ys_e, ys_l], axis=1), h_f, c_f
     n_out = h0.shape[-1]
     xproj = (x.reshape(n * t, -1) @ params["W"] + params["b"]).reshape(n, t, 4 * n_out)
     if is_tanh and mask is None and not reverse:
@@ -117,9 +139,12 @@ class GravesLSTMImpl(BaseLayerImpl):
         }
         return params, state, (t, n_out)
 
-    def apply(self, params, state, x, *, train=False, rng=None, mask=None, carry_state=False):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              carry_state=False, backprop_window=None):
         """carry_state=True resumes from state['h'/'c'] (TBPTT window chaining,
-        reference doTruncatedBPTT; state shape must match the batch)."""
+        reference doTruncatedBPTT; state shape must match the batch).
+        backprop_window truncates the in-window backward pass (distinct
+        tbptt_back_length — see _scan_lstm)."""
         x = self._dropout_in(x, train, rng)
         n = x.shape[0]
         n_out = self.conf.n_out
@@ -132,6 +157,7 @@ class GravesLSTMImpl(BaseLayerImpl):
         ys, h_f, c_f = _scan_lstm(
             self.act, params, x, h0, c0, mask,
             is_tanh=(self.conf.activation or "tanh") == "tanh",
+            backprop_window=backprop_window,
         )
         if mask is not None:
             ys = ys * jnp.asarray(mask, ys.dtype)[..., None]
@@ -163,9 +189,12 @@ class GravesBidirectionalLSTMImpl(BaseLayerImpl):
         }
         return params, {}, (t, n_out)
 
-    def apply(self, params, state, x, *, train=False, rng=None, mask=None, carry_state=False):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              carry_state=False, backprop_window=None):
         # bidirectional layers cannot carry state across TBPTT windows (the
-        # backward pass needs the full window anyway; reference behaves the same)
+        # backward pass needs the full window anyway; reference behaves the
+        # same), and backprop_window is ignored: the two directions would
+        # truncate at opposite ends, so the whole window backprops
         x = self._dropout_in(x, train, rng)
         n = x.shape[0]
         n_out = self.conf.n_out
@@ -211,7 +240,8 @@ class GRUImpl(BaseLayerImpl):
             h = jnp.where(mask_t, h, h_prev)
         return h
 
-    def apply(self, params, state, x, *, train=False, rng=None, mask=None, carry_state=False):
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              carry_state=False, backprop_window=None):
         x = self._dropout_in(x, train, rng)
         n, t, _ = x.shape
         n_out = self.conf.n_out
@@ -219,6 +249,25 @@ class GRUImpl(BaseLayerImpl):
             h0 = jnp.asarray(state["h"], x.dtype)
         else:
             h0 = jnp.zeros((n, n_out), x.dtype)
+        ys, h_f = self._scan(params, x, h0, mask, backprop_window)
+        if mask is not None:
+            ys = ys * jnp.asarray(mask, ys.dtype)[..., None]
+        return ys, {"h": h_f}
+
+    def _scan(self, params, x, h0, mask, backprop_window=None):
+        """[N,T,F] scan; backprop_window splits with stop_gradient like
+        _scan_lstm (reference tbpttBackpropGradient back-length truncation)."""
+        n, t, _ = x.shape
+        n_out = self.conf.n_out
+        if backprop_window is not None and 0 < backprop_window < t:
+            cut = t - backprop_window
+            m_e = mask[:, :cut] if mask is not None else None
+            m_l = mask[:, cut:] if mask is not None else None
+            ys_e, h_m = self._scan(params, x[:, :cut], h0, m_e)
+            ys_e = lax.stop_gradient(ys_e)
+            h_m = lax.stop_gradient(h_m)
+            ys_l, h_f = self._scan(params, x[:, cut:], h_m, m_l)
+            return jnp.concatenate([ys_e, ys_l], axis=1), h_f
         xproj = (x.reshape(n * t, -1) @ params["W"] + params["b"]).reshape(
             n, t, 3 * n_out
         )
@@ -237,10 +286,7 @@ class GRUImpl(BaseLayerImpl):
 
         xs = (xproj_t, mask_t) if mask is not None else xproj_t
         h_f, hs = lax.scan(step, h0, xs)
-        ys = jnp.swapaxes(hs, 0, 1)
-        if mask is not None:
-            ys = ys * jnp.asarray(mask, ys.dtype)[..., None]
-        return ys, {"h": h_f}
+        return jnp.swapaxes(hs, 0, 1), h_f
 
     def step(self, params, state, x_t):
         n = x_t.shape[0]

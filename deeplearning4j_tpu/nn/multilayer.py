@@ -137,11 +137,14 @@ class MultiLayerNetwork:
         mask=None,
         upto: Optional[int] = None,
         carry_state: bool = False,
+        backprop_window: Optional[int] = None,
     ):
         """Forward through layers [0, upto). Returns (activations list incl.
         input, new_states). Mask is passed to recurrent-family layers only.
         carry_state=True resumes recurrent layers from their stored state
-        (TBPTT window chaining)."""
+        (TBPTT window chaining). backprop_window truncates each recurrent
+        layer's in-window backward pass (distinct tbptt_back_length,
+        reference LSTMHelpers.backpropGradientHelper:255)."""
         n_layers = len(self.layers) if upto is None else upto
         batch_n = x.shape[0]
         acts = [x]
@@ -156,6 +159,10 @@ class MultiLayerNetwork:
             kwargs = {}
             if carry_state and isinstance(self.conf.layers[i], STATEFUL_RNN_CONFS):
                 kwargs["carry_state"] = True
+            if backprop_window is not None and isinstance(
+                self.conf.layers[i], STATEFUL_RNN_CONFS
+            ):
+                kwargs["backprop_window"] = backprop_window
             y, ns = layer.apply(
                 params[i], states[i], x, train=train, rng=lrng, mask=lmask, **kwargs
             )
@@ -199,6 +206,7 @@ class MultiLayerNetwork:
         mask=None,
         label_mask=None,
         carry_state: bool = False,
+        backprop_window: Optional[int] = None,
     ):
         out_impl = self.layers[-1]
         if not isinstance(out_impl, OutputLayerImpl):
@@ -212,6 +220,7 @@ class MultiLayerNetwork:
             mask=mask,
             upto=len(self.layers) - 1,
             carry_state=carry_state,
+            backprop_window=backprop_window,
         )
         last_in = self._apply_preprocessor(
             len(self.layers) - 1, acts[-1], x.shape[0]
@@ -226,9 +235,13 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- jit cache
     def _get_train_step(
-        self, has_mask: bool, has_label_mask: bool, carry_state: bool = False
+        self,
+        has_mask: bool,
+        has_label_mask: bool,
+        carry_state: bool = False,
+        backprop_window: Optional[int] = None,
     ):
-        key = ("train_step", has_mask, has_label_mask, carry_state)
+        key = ("train_step", has_mask, has_label_mask, carry_state, backprop_window)
         if key in self._jit_cache:
             return self._jit_cache[key]
 
@@ -244,6 +257,7 @@ class MultiLayerNetwork:
                     mask=mask,
                     label_mask=label_mask,
                     carry_state=carry_state,
+                    backprop_window=backprop_window,
                 )
 
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -349,27 +363,11 @@ class MultiLayerNetwork:
                     for k in self.states[i]
                 }
 
-    def _fit_tbptt(self, features, labels, mask=None, label_mask=None) -> float:
-        """Truncated BPTT: slice the time axis into fwd-length windows;
-        recurrent state carries forward across windows (stop-gradient at the
-        window boundary — state enters the next jitted step as data), matching
-        reference doTruncatedBPTT :1162-1233."""
-        if features.ndim != 3:
-            raise ValueError(
-                "backprop_type='truncated_bptt' requires [B,T,F] features"
-            )
-        if self.conf.tbptt_back_length != self.conf.tbptt_fwd_length:
-            import warnings
-
-            warnings.warn(
-                "tbptt_back_length != tbptt_fwd_length: gradients are "
-                "truncated at the forward-window boundary (back length "
-                "ignored)", stacklevel=3,
-            )
+    def _tbptt_windows(self, features, labels, mask=None, label_mask=None):
+        """Yield (f_w, l_w, m_w, lm_w) fwd-length window slices along time
+        (reference doTruncatedBPTT :1183-1199 subset extraction)."""
         t_total = features.shape[1]
         w = self.conf.tbptt_fwd_length
-        loss = float("nan")
-        self._reset_rnn_states(features.shape[0])
         for window_start in range(0, t_total, w):
             sl = slice(window_start, min(window_start + w, t_total))
             f_w = features[:, sl]
@@ -384,8 +382,33 @@ class MultiLayerNetwork:
                 if label_mask is not None and labels.ndim == 3
                 else label_mask
             )
+            yield f_w, l_w, m_w, lm_w
+
+    def _tbptt_backprop_window(self) -> Optional[int]:
+        from deeplearning4j_tpu.nn.common import tbptt_backprop_window
+
+        return tbptt_backprop_window(self.conf)
+
+    def _fit_tbptt(self, features, labels, mask=None, label_mask=None) -> float:
+        """Truncated BPTT: slice the time axis into fwd-length windows;
+        recurrent state carries forward across windows (stop-gradient at the
+        window boundary — state enters the next jitted step as data), matching
+        reference doTruncatedBPTT :1162-1233. A shorter tbptt_back_length
+        truncates the backward pass inside each window via stop-gradient
+        segments (LSTMHelpers.backpropGradientHelper:255)."""
+        if features.ndim != 3:
+            raise ValueError(
+                "backprop_type='truncated_bptt' requires [B,T,F] features"
+            )
+        loss = float("nan")
+        self._reset_rnn_states(features.shape[0])
+        bw = self._tbptt_backprop_window()
+        for f_w, l_w, m_w, lm_w in self._tbptt_windows(
+            features, labels, mask, label_mask
+        ):
             step = self._get_train_step(
-                m_w is not None, lm_w is not None, carry_state=True
+                m_w is not None, lm_w is not None, carry_state=True,
+                backprop_window=bw,
             )
             srng = rng_mod.step_key(self._rng, self.iteration)
             self.params, self.states, self.updater_state, loss = step(
@@ -523,41 +546,101 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------- stateful rnn streaming
     def rnn_clear_previous_state(self):
+        """Zero streaming RNN state WITHOUT touching params (reference
+        rnnClearPreviousState just clears stateMap). State leaves go back to
+        the lazily-sized empty form; the next rnn_time_step re-sizes them."""
         for i, layer in enumerate(self.layers):
             if hasattr(layer, "step"):
-                p, s, _ = layer.initialize(
-                    rng_mod.layer_key(self._rng, i, "init"), self._layer_input_shape(i)
-                )
-                self.states[i] = s
+                self.states[i] = {
+                    k: jnp.zeros((0,) + v.shape[1:], v.dtype)
+                    for k, v in self.states[i].items()
+                }
 
-    def _layer_input_shape(self, i):
-        # recompute shapes chain (cheap, static)
-        shape = self._input_shape
-        for j in range(i):
-            pp = self.conf.input_preprocessors.get(j)
-            if pp is not None:
-                shape = pp.out_shape(shape)
-            _, _, shape = self.layers[j].initialize(
-                rng_mod.layer_key(self._rng, j, "init"), shape
-            )
-        pp = self.conf.input_preprocessors.get(i)
-        return pp.out_shape(shape) if pp is not None else shape
+    def _sized_rnn_states(self, states, n: int):
+        """States with stream-state leaves sized for batch n. Only the
+        intentionally cleared (0, ...) form is re-sized; any other batch
+        mismatch raises (silently zeroing carried state would produce wrong
+        predictions with no signal — call rnn_clear_previous_state first)."""
+        out = list(states)
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "step"):
+                sized = {}
+                for k, v in states[i].items():
+                    if v.shape[0] == n:
+                        sized[k] = v
+                    elif v.shape[0] == 0:
+                        sized[k] = jnp.zeros((n,) + v.shape[1:], v.dtype)
+                    else:
+                        raise ValueError(
+                            f"rnn_time_step batch {n} != carried state batch "
+                            f"{v.shape[0]} (layer {i}); call "
+                            "rnn_clear_previous_state() to start a new stream"
+                        )
+                out[i] = sized
+        return out
+
+    def _get_rnn_step_fn(self):
+        """Jitted single-timestep forward through the whole stack with carried
+        RNN state — the streaming-inference hot path (reference rnnTimeStep
+        :2152 keeps a stateMap per layer; here state is an explicit pytree so
+        the step is one compiled XLA program)."""
+        key = ("rnn_step",)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(self._rnn_step_body)
+        return self._jit_cache[key]
+
+    def _get_rnn_seq_fn(self):
+        """Jitted [N,T,F] stepwise path: lax.scan of the single-step function
+        over time (state carries across calls like repeated rnn_time_step)."""
+        key = ("rnn_seq",)
+        if key not in self._jit_cache:
+
+            def seq_fn(params, states, x):
+                def body(states, x_t):
+                    y, new_states = self._rnn_step_body(params, states, x_t)
+                    return new_states, y
+
+                states, ys = jax.lax.scan(body, states, jnp.swapaxes(x, 0, 1))
+                return jnp.swapaxes(ys, 0, 1), states
+
+            self._jit_cache[key] = jax.jit(seq_fn)
+        return self._jit_cache[key]
+
+    def _rnn_step_body(self, params, states, x):
+        new_states = list(states)
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "step"):
+                x, new_states[i] = layer.step(params[i], states[i], x)
+            else:
+                x, _ = layer.apply(params[i], states[i], x, train=False)
+        return x, new_states
 
     def rnn_time_step(self, x_t) -> jax.Array:
-        """One-timestep stateful inference (reference rnnTimeStep :2152).
-        x_t: [N, F] (single step) or [N, T, F] (processed stepwise)."""
+        """Stateful streaming inference (reference rnnTimeStep :2152).
+        x_t: [N, F] (single step) or [N, T, F] (scanned stepwise). State
+        carries across calls; both paths are single jitted XLA programs."""
         x_t = jnp.asarray(x_t)
+        n = x_t.shape[0]
+        states = self._sized_rnn_states(self.states, n)
         if x_t.ndim == 3:
-            outs = [self.rnn_time_step(x_t[:, t]) for t in range(x_t.shape[1])]
-            return jnp.stack(outs, axis=1)
-        x = x_t
-        for i, layer in enumerate(self.layers):
-            if hasattr(layer, "step"):
-                y, self.states[i] = layer.step(self.params[i], self.states[i], x)
-            else:
-                y, _ = layer.apply(self.params[i], self.states[i], x, train=False)
-            x = y
-        return x
+            ys, self.states = self._get_rnn_seq_fn()(self.params, states, x_t)
+            return ys
+        y, self.states = self._get_rnn_step_fn()(self.params, states, x_t)
+        return y
+
+    def apply_lr_score_decay(self) -> None:
+        """Multiply the effective LR by lr_policy_decay_rate (reference
+        Model.applyLearningRateScoreDecay — the event-driven 'score' LR
+        policy, fired by BaseOptimizer.checkTerminalConditions:239 on an
+        eps-plateau). The cumulative factor lives in updater state."""
+        from deeplearning4j_tpu.nn.common import decay_lr_scale_entry
+
+        rate = self.conf.lr_policy_decay_rate
+        if rate is None:
+            return
+        self.updater_state = [
+            decay_lr_scale_entry(s, rate) for s in self.updater_state
+        ]
 
     # ------------------------------------------------------------- listeners
     def set_listeners(self, *listeners):

@@ -353,6 +353,13 @@ class Solver:
         for lst in net.listeners:
             lst.iteration_done(net, net.iteration, res.score)
         net.iteration += res.iterations
+        if (
+            res.converged
+            and (getattr(net.conf, "lr_policy", "none") or "none") == "score"
+        ):
+            # eps-plateau termination + 'score' policy => decay the LR
+            # (reference BaseOptimizer.checkTerminalConditions:239)
+            net.apply_lr_score_decay()
         return res.score
 
     def optimize(self, features, labels, mask=None, label_mask=None,

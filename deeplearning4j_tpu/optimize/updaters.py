@@ -144,6 +144,17 @@ class LayerUpdater:
 
     # ---- state ------------------------------------------------------------
     def init(self, params) -> Dict[str, Any]:
+        state = self._init_rule_state(params)
+        if (getattr(self.net_conf, "lr_policy", None) or "none") == "score":
+            # score policy is event-driven (reference
+            # BaseOptimizer.checkTerminalConditions:239 calls
+            # applyLearningRateScoreDecay on an eps-plateau); the cumulative
+            # decay lives in updater state so the jitted step sees it as data
+            state = dict(state)
+            state["lr_scale"] = jnp.ones((), jnp.float32)
+        return state
+
+    def _init_rule_state(self, params) -> Dict[str, Any]:
         zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
         k = self.kind
         if k in ("sgd", "none"):
@@ -161,7 +172,7 @@ class LayerUpdater:
         raise ValueError(f"unknown updater {self.kind}")
 
     # ---- the update rule, leaf-wise ---------------------------------------
-    def _lrs(self, params, iteration):
+    def _lrs(self, params, iteration, scale=None):
         """Per-leaf learning rate tree (bias params get bias_learning_rate)."""
         lr = lr_at(self.net_conf, self.conf.learning_rate, iteration)
         bias_lr = lr_at(
@@ -169,6 +180,9 @@ class LayerUpdater:
             self.conf.bias_learning_rate or self.conf.learning_rate,
             iteration,
         )
+        if scale is not None:
+            lr = lr * scale
+            bias_lr = bias_lr * scale
 
         def leaf_lr(path, _):
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
@@ -179,12 +193,22 @@ class LayerUpdater:
     def update(
         self, grads, state, params, iteration
     ) -> Tuple[Dict[str, Array], Dict[str, Any]]:
+        scale = state.get("lr_scale") if isinstance(state, dict) else None
+        upd, new_state = self._update_rule(grads, state, params, iteration, scale)
+        if scale is not None:
+            new_state = dict(new_state)
+            new_state["lr_scale"] = scale
+        return upd, new_state
+
+    def _update_rule(
+        self, grads, state, params, iteration, scale=None
+    ) -> Tuple[Dict[str, Array], Dict[str, Any]]:
         grads = normalize_gradients(
             grads,
             self.conf.gradient_normalization,
             self.conf.gradient_normalization_threshold or 1.0,
         )
-        lrs = self._lrs(params, iteration)
+        lrs = self._lrs(params, iteration, scale)
         tmap = jax.tree_util.tree_map
         k = self.kind
         eps = self.conf.epsilon or 1e-8

@@ -77,6 +77,8 @@ class ParallelWrapper:
         y = jax.device_put(jnp.asarray(labels), self.data_sharding)
         m = None if mask is None else jax.device_put(jnp.asarray(mask), self.data_sharding)
         lm = None if label_mask is None else jax.device_put(jnp.asarray(label_mask), self.data_sharding)
+        if net.conf.backprop_type == "truncated_bptt" and x.ndim == 3:
+            return self._fit_tbptt_mln(x, y, m, lm)
         step = net._get_train_step(m is not None, lm is not None)
         loss = None
         for _ in range(max(1, net.conf.iterations)):  # same loop as net.fit
@@ -95,15 +97,62 @@ class ParallelWrapper:
                 "(pad or trim — static shapes keep the step compiled once)"
             )
 
+    def _shard_rnn_states(self):
+        """Place recurrent stream state (batch-dim leaves) on the data axis;
+        everything else stays replicated. Called after a state reset sized
+        for the global batch. Handles both containers (MLN list states /
+        graph dict states)."""
+        net = self.net
+        from deeplearning4j_tpu.nn.layers.factory import STATEFUL_RNN_CONFS
+
+        put = lambda t: jax.device_put(t, self.data_sharding)
+        if isinstance(net.states, dict):  # ComputationGraph
+            net.states = {
+                n: (
+                    {k: put(v) for k, v in s.items()}
+                    if isinstance(net.conf.vertices[n], STATEFUL_RNN_CONFS)
+                    else s
+                )
+                for n, s in net.states.items()
+            }
+        else:
+            net.states = [
+                (
+                    {k: put(v) for k, v in s.items()}
+                    if isinstance(net.conf.layers[i], STATEFUL_RNN_CONFS)
+                    else s
+                )
+                for i, s in enumerate(net.states)
+            ]
+
+    def _fit_tbptt_mln(self, x, y, m, lm) -> float:
+        """Data-parallel truncated BPTT: the same fwd-window loop as
+        MultiLayerNetwork._fit_tbptt, with the batch (and the carried
+        recurrent state) sharded over the mesh — each window step is one
+        GSPMD program with the gradient psum inside (reference
+        doTruncatedBPTT :1162-1233 under ParallelWrapper)."""
+        net = self.net
+        net._reset_rnn_states(x.shape[0])
+        self._shard_rnn_states()
+        bw = net._tbptt_backprop_window()
+        loss = None
+        for f_w, l_w, m_w, lm_w in net._tbptt_windows(x, y, m, lm):
+            step = net._get_train_step(
+                m_w is not None, lm_w is not None, carry_state=True,
+                backprop_window=bw,
+            )
+            srng = rng_mod.step_key(net._rng, net.iteration)
+            net.params, net.states, net.updater_state, loss = step(
+                net.params, net.states, net.updater_state, f_w, l_w,
+                jnp.asarray(net.iteration, jnp.int32), srng, m_w, lm_w,
+            )
+            net._record_iteration(loss)
+        return loss
+
     def _fit_graph(self, features, labels, masks=None, label_masks=None) -> float:
         from deeplearning4j_tpu.nn.graph import _as_list
 
         net = self.net
-        if net.conf.backprop_type == "truncated_bptt":
-            raise NotImplementedError(
-                "ParallelWrapper does not yet shard truncated-BPTT graph "
-                "training; use net.fit per window or standard backprop"
-            )
         if net.conf.optimization_algo != "stochastic_gradient_descent":
             raise NotImplementedError(
                 "ParallelWrapper shards the SGD train step; "
@@ -127,6 +176,8 @@ class ParallelWrapper:
             if label_masks is not None
             else None
         )
+        if net.conf.backprop_type == "truncated_bptt":
+            return self._fit_tbptt_graph(inputs, labels_l, masks_d, lmasks)
         step = net._get_train_step(len(labels_l), lmasks is not None)
         loss = None
         for _ in range(max(1, net.conf.iterations)):  # same loop as net.fit
@@ -137,6 +188,17 @@ class ParallelWrapper:
             )
             net._record_iteration(loss)
         return loss
+
+    def _fit_tbptt_graph(self, inputs, labels_l, masks_d, lmasks) -> float:
+        """DP truncated BPTT over a DAG: delegate to the graph's own window
+        loop — inputs/labels arrive batch-sharded and time-slicing preserves
+        that sharding, so every window step runs under GSPMD with the
+        gradient psum inside (reference ComputationGraph TBPTT under
+        ParallelWrapper)."""
+        return self.net._fit_tbptt(
+            inputs, labels_l, masks_d, lmasks,
+            state_placer=self._shard_rnn_states,
+        )
 
     def fit_iterator(self, iterator, num_epochs: int = 1):
         for _ in range(num_epochs):
@@ -172,25 +234,32 @@ class ParameterAveragingTrainer:
         self.n = int(np.prod(self.mesh.devices.shape))
         self.averaging_frequency = max(1, int(averaging_frequency))
         self.save_updater = save_updater
-        self._step_fn = None
+        self._step_fns = {}
 
-    def _build_step(self):
+    def _build_step(self, has_mask: bool, has_label_mask: bool):
         """shard_map worker: local minibatch loop, then pmean of params (+
-        updater state if save_updater — reference saveUpdater flag)."""
-        net = self.net
-        freq = self.averaging_frequency
-        save_updater = self.save_updater
+        updater state if save_updater — reference saveUpdater flag).
 
-        def worker(params, states, upd_state, xs, ys, iteration, rngs):
+        States: batch-statistics states (BN running mean/var — params in the
+        reference, so they ARE averaged, BatchNormalizationParamInitializer)
+        are pmean'd; recurrent stream states are NOT (reference workers are
+        rebuilt from broadcast each split, ExecuteWorkerFlatMap.java:35-100 —
+        worker RNN state never crosses the averaging boundary): they pass
+        through unchanged."""
+        net = self.net
+        save_updater = self.save_updater
+        from deeplearning4j_tpu.nn.layers.factory import STATEFUL_RNN_CONFS
+
+        def worker(params, states, upd_state, xs, ys, ms, lms, iteration, rngs):
             # xs: [freq, local_b, ...] — this worker's minibatch sequence
             def body(carry, inp):
-                params, states, upd_state, it = carry
-                x, r = inp
+                params, st, upd_state, it = carry
+                (x, y, m, lm), r = inp
 
                 def loss_fn(p):
                     return net._loss(
-                        p, states, x[0], x[1], train=True, rng=r, mask=None,
-                        label_mask=None,
+                        p, st, x, y, train=True, rng=r, mask=m,
+                        label_mask=lm,
                     )
 
                 (loss, new_states), grads = jax.value_and_grad(
@@ -202,54 +271,85 @@ class ParameterAveragingTrainer:
                 params = apply_updates(params, updates, net.conf.minimize)
                 return (params, new_states, upd_state2, it + 1), loss
 
-            (params, states, upd_state, _), losses = jax.lax.scan(
-                body, (params, states, upd_state, iteration), ((xs, ys), rngs)
+            (params, out_states, upd_state, _), losses = jax.lax.scan(
+                body, (params, states, upd_state, iteration),
+                ((xs, ys, ms, lms), rngs),
             )
             # averaging round: params (and updater state) pmean'd over workers
             params = jax.lax.pmean(params, DATA_AXIS)
             if save_updater:
                 upd_state = jax.lax.pmean(upd_state, DATA_AXIS)
-            states = jax.lax.pmean(states, DATA_AXIS)
-            return params, states, upd_state, jax.lax.pmean(jnp.mean(losses), DATA_AXIS)
+            final_states = [
+                (
+                    st_in  # recurrent stream state: local, not averaged
+                    if isinstance(net.conf.layers[i], STATEFUL_RNN_CONFS)
+                    else jax.lax.pmean(st_out, DATA_AXIS)
+                )
+                for i, (st_in, st_out) in enumerate(zip(states, out_states))
+            ]
+            return (
+                params,
+                final_states,
+                upd_state,
+                jax.lax.pmean(jnp.mean(losses), DATA_AXIS),
+            )
 
         repl = P()
         sharded = P(None, DATA_AXIS)  # [freq, global_b, ...] split on batch axis
+        m_spec = sharded if has_mask else repl
+        lm_spec = sharded if has_label_mask else repl
         fn = shard_map(
             worker,
             mesh=self.mesh,
-            in_specs=(repl, repl, repl, sharded, sharded, repl, P(None)),
+            in_specs=(repl, repl, repl, sharded, sharded, m_spec, lm_spec,
+                      repl, P(None)),
             out_specs=(repl, repl, repl, repl),
             check_vma=False,
         )
         return jax.jit(fn)
 
-    def fit(self, features, labels) -> float:
-        """One averaging round: features [freq*n*b, ...] or [freq, n*b, ...]."""
+    def _to_rounds(self, a):
+        """[freq*gb, ...] -> [freq, gb, ...] minibatch stacking."""
+        if a is None:
+            return None
+        a = jnp.asarray(a)
+        if a.ndim >= 2 and a.shape[0] != self.averaging_frequency:
+            gb = a.shape[0] // self.averaging_frequency
+            a = a[: gb * self.averaging_frequency].reshape(
+                (self.averaging_frequency, gb) + a.shape[1:]
+            )
+        return a
+
+    def fit(self, features, labels, mask=None, label_mask=None) -> float:
+        """One averaging round: features [freq*n*b, ...] or [freq, n*b, ...].
+        Feature/label masks (variable-length sequences) shard with the batch
+        (reference workers pass the DataSet's mask arrays to net.fit)."""
         net = self.net
         if net.params is None:
             net.init()
-        x = jnp.asarray(features)
-        y = jnp.asarray(labels)
-        if x.ndim >= 2 and x.shape[0] != self.averaging_frequency:
-            # split flat batch into freq minibatches
-            gb = x.shape[0] // self.averaging_frequency
-            x = x[: gb * self.averaging_frequency].reshape(
-                (self.averaging_frequency, gb) + x.shape[1:]
-            )
-            y = y[: gb * self.averaging_frequency].reshape(
-                (self.averaging_frequency, gb) + y.shape[1:]
-            )
-        if self._step_fn is None:
-            self._step_fn = self._build_step()
+        x = self._to_rounds(features)
+        y = self._to_rounds(labels)
+        m = self._to_rounds(mask)
+        lm = self._to_rounds(label_mask)
+        # worker RNN stream state is per-round local (reference workers are
+        # rebuilt from broadcast each split): size it for the LOCAL batch so
+        # the scan carry is shape-stable
+        if hasattr(net, "_reset_rnn_states"):
+            net._reset_rnn_states(x.shape[1] // self.n)
+        key = (m is not None, lm is not None)
+        if key not in self._step_fns:
+            self._step_fns[key] = self._build_step(*key)
         rngs = jax.vmap(lambda i: rng_mod.step_key(net._rng, i))(
             jnp.arange(net.iteration, net.iteration + self.averaging_frequency)
         )
-        net.params, net.states, net.updater_state, loss = self._step_fn(
+        net.params, net.states, net.updater_state, loss = self._step_fns[key](
             net.params,
             net.states,
             net.updater_state,
             x,
             y,
+            m,
+            lm,
             jnp.asarray(net.iteration, jnp.int32),
             rngs,
         )

@@ -142,3 +142,48 @@ def test_missing_layer_index_raises():
             .layer(2, OutputLayer(n_in=2, n_out=2))
             .build()
         )
+
+
+def test_yaml_round_trip():
+    """Reference NeuralNetConfiguration.java:285-345 supports both JSON and
+    YAML mappers; both round-trip the same dict schema."""
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(9)
+        .learning_rate(0.01)
+        .updater("adam")
+        .list()
+        .layer(0, DenseLayer(n_in=5, n_out=7, activation="relu", dropout=0.25))
+        .layer(1, OutputLayer(n_in=7, n_out=2, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    back = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert back.to_dict() == conf.to_dict()
+
+
+def test_graph_yaml_round_trip():
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_in=4, n_out=6, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_in=6, n_out=3, activation="softmax",
+                                      loss_function="mcxent"), "d")
+        .set_outputs("out")
+        .build()
+    )
+    back = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+    assert back.to_dict() == conf.to_dict()

@@ -235,3 +235,41 @@ def test_gru_carry_state_resumes():
         np.asarray(y_full), np.asarray(jnp.concatenate([y1, y2], axis=1)),
         rtol=1e-5, atol=1e-6,
     )
+
+
+def test_tbptt_backprop_window_truncates_input_grads():
+    """backprop_window=B: gradients flow only through the last B timesteps
+    (reference LSTMHelpers.backpropGradientHelper:255 endIdx truncation);
+    earlier steps contribute values but zero gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, GRU
+    from deeplearning4j_tpu.nn.layers.factory import create_layer
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((2, 6, 4)).astype(np.float32))
+    for conf in (
+        GravesLSTM(n_in=4, n_out=3, activation="tanh", weight_init="xavier"),
+        GRU(n_in=4, n_out=3, activation="tanh", weight_init="xavier"),
+    ):
+        layer = create_layer(conf)
+        params, state, _ = layer.initialize(jax.random.PRNGKey(0), (6, 4))
+
+        def loss(xx, bw):
+            y, _ = layer.apply(params, state, xx, backprop_window=bw)
+            return jnp.sum(y * y)
+
+        g_full = jax.grad(lambda xx: loss(xx, None))(x)
+        g_trunc = jax.grad(lambda xx: loss(xx, 2))(x)
+        # early-step input grads are exactly zero under truncation
+        np.testing.assert_array_equal(np.asarray(g_trunc[:, :4]), 0.0)
+        assert np.abs(np.asarray(g_trunc[:, 4:])).max() > 0
+        # full-window grads are generally nonzero at early steps
+        assert np.abs(np.asarray(g_full[:, :4])).max() > 0
+        # forward values are unchanged by the truncation
+        y_full, _ = layer.apply(params, state, x)
+        y_trunc, _ = layer.apply(params, state, x, backprop_window=2)
+        np.testing.assert_allclose(
+            np.asarray(y_full), np.asarray(y_trunc), rtol=1e-6
+        )

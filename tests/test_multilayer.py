@@ -145,3 +145,64 @@ def test_clone_independent():
     assert not np.allclose(
         np.asarray(net.params[0]["W"]), np.asarray(c.params[0]["W"])
     )
+
+
+# ---------------------------------------------------------------- streaming
+def lstm_net(seed=7):
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(0.1)
+        .weight_init("xavier")
+        .list()
+        .layer(0, GravesLSTM(n_in=6, n_out=8, activation="tanh"))
+        .layer(
+            1,
+            RnnOutputLayer(
+                n_in=8, n_out=4, activation="softmax", loss_function="mcxent"
+            ),
+        )
+        .build()
+    )
+    return MultiLayerNetwork(conf).init(input_shape=(1, 6))
+
+
+def test_rnn_time_step_matches_batch_forward():
+    """Streaming stepwise inference == batch forward at every timestep
+    (reference rnnTimeStep :2152 contract)."""
+    net = lstm_net()
+    rng = np.random.default_rng(0)
+    x = rng.random((3, 5, 6)).astype(np.float32)
+    batch_out = np.asarray(net.output(x))  # [3,5,4]
+    net.rnn_clear_previous_state()
+    for t in range(5):
+        step_out = np.asarray(net.rnn_time_step(x[:, t]))
+        np.testing.assert_allclose(step_out, batch_out[:, t], rtol=2e-5, atol=1e-6)
+
+
+def test_rnn_time_step_seq_path_matches_stepwise():
+    """[N,T,F] input runs the scanned path; equals repeated single steps and
+    carries state across calls."""
+    net = lstm_net()
+    rng = np.random.default_rng(1)
+    x = rng.random((2, 6, 6)).astype(np.float32)
+    net.rnn_clear_previous_state()
+    seq_out = np.asarray(net.rnn_time_step(x))  # scan path
+    h_after_seq = np.asarray(net.states[0]["h"])
+    net.rnn_clear_previous_state()
+    steps = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(6)]
+    np.testing.assert_allclose(seq_out, np.stack(steps, axis=1), rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(h_after_seq, np.asarray(net.states[0]["h"]), rtol=2e-5, atol=1e-6)
+
+
+def test_rnn_clear_previous_state_keeps_params():
+    net = lstm_net()
+    w_before = np.asarray(net.params[0]["W"]).copy()
+    rng = np.random.default_rng(2)
+    net.rnn_time_step(rng.random((2, 6)).astype(np.float32))
+    assert np.asarray(net.states[0]["h"]).shape == (2, 8)
+    net.rnn_clear_previous_state()
+    assert np.asarray(net.states[0]["h"]).shape[0] == 0
+    np.testing.assert_array_equal(w_before, np.asarray(net.params[0]["W"]))

@@ -169,3 +169,44 @@ def test_clip_l2_per_param_type():
 def test_clip_l2_noop_when_under_threshold():
     out = normalize_gradients(G, "clip_l2_per_layer", 1e9)
     np.testing.assert_allclose(out["W"], np.asarray(G["W"]))
+
+
+def test_score_lr_policy_decay():
+    """'score' LR policy: event-driven decay via apply_lr_score_decay
+    (reference BaseOptimizer.checkTerminalConditions:239 +
+    Model.applyLearningRateScoreDecay)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .learning_rate(0.5)
+        .learning_rate_policy("score")
+        .lr_policy_decay_rate(0.1)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    assert float(net.updater_state[0].get("lr_scale", -1)) == 1.0
+    x, y = load_iris()
+    net.fit(x, y)
+    p_before = np.asarray(net.params[0]["W"]).copy()
+    net.fit(x, y)
+    full_step = np.abs(np.asarray(net.params[0]["W"]) - p_before).max()
+    net.apply_lr_score_decay()
+    assert abs(float(net.updater_state[0]["lr_scale"]) - 0.1) < 1e-6
+    p_before = np.asarray(net.params[0]["W"]).copy()
+    net.fit(x, y)
+    decayed_step = np.abs(np.asarray(net.params[0]["W"]) - p_before).max()
+    assert decayed_step < full_step * 0.5, (full_step, decayed_step)
