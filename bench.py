@@ -1,66 +1,405 @@
 #!/usr/bin/env python
 """Benchmark driver — prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}
 
-Headline metric: LeNet-5 MNIST training throughput (samples/sec/chip) —
-BASELINE.json configs[0]. The reference publishes no numbers
-(BASELINE.md), so vs_baseline is reported against a self-measured
-nd4j-era CPU figure recorded here as REFERENCE_CPU_SAMPLES_PER_SEC once
-available; until then vs_baseline = 1.0 and the absolute number is the
-tracked quantity.
+Covers all five BASELINE.json configs plus the north-star equivalence bar:
+  configs[0] LeNet-5 MNIST      -> lenet5 samples/sec/chip (headline metric)
+  configs[1] MLP+LSTM char-RNN  -> char_rnn train samples/sec + tokens/sec
+                                   + rnn_time_step generation chars/sec
+  configs[2] ResNet-50          -> samples/sec/chip + MFU (XLA-counted step
+                                   FLOPs / peak chip FLOPs)
+  configs[3] Word2Vec SGNS      -> skip-gram pairs/sec
+  configs[4] 1→8 scaling        -> measured on the virtual 8-device CPU mesh
+                                   (this host exposes ONE real TPU chip and
+                                   ONE cpu core, so the honest number is the
+                                   equal-work DP overhead ratio; raw 1→8
+                                   speedup on a 1-core host is meaningless
+                                   and labeled as such)
+  north_star                    -> 100-step CPU-vs-TPU float32-strict loss
+                                   curve deviation (written to
+                                   NORTHSTAR_r.json artifact)
+
+vs_baseline: measured against a faithful torch-CPU LeNet-5 reimplementation
+of the reference's nd4j-native CPU training path (the reference itself is
+2016 Java/ND4J and cannot run here; torch-cpu is a GENEROUS stand-in — BLAS
+conv + hand-tuned kernels, no per-op JVM dispatch — so the ratio understates
+our advantage over real dl4j). Reference comparison path:
+MultiLayerNetwork.fit :1017 (see BASELINE.md).
+
+Data provenance is reported per dataset ("local"/"downloaded"/"synthetic");
+this host is zero-egress so MNIST falls back to the deterministic synthetic
+stand-in unless idx files are provided via DL4J_TPU_DATA_DIR.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+os.environ.setdefault("DL4J_TPU_OFFLINE", "")  # downloads attempted once
 
-# Self-baselined: no published reference numbers exist (BASELINE.md). This
-# constant tracks OUR first-round measurement so later rounds report progress.
-REFERENCE_CPU_SAMPLES_PER_SEC = None  # filled once a reference-side run exists
-FIRST_ROUND_SAMPLES_PER_SEC = None  # set after round 1 records BENCH_r1.json
+
+def _log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: repeat bench runs (the driver runs
+    bench every round) skip the slow first-compile through the TPU tunnel."""
+    import jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/.jax_compile_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001
+        _log(f"compile cache unavailable: {e}")
+
+
+def _time_steps(fn, warmup: int, steps: int, sync):
+    for _ in range(warmup):
+        fn()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        fn()
+    sync()
+    return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# configs[0]: LeNet-5 MNIST
+# ---------------------------------------------------------------------------
+
+
+def bench_lenet(batch=512, steps=30):
+    import jax
+
+    from deeplearning4j_tpu.datasets.fetchers import load_mnist_info
+    from deeplearning4j_tpu.models.lenet import build_lenet5
+
+    net = build_lenet5()
+    x, y, prov = load_mnist_info(train=True, num_examples=batch * 4)
+    xs = [x[i * batch : (i + 1) * batch] for i in range(4)]
+    ys = [y[i * batch : (i + 1) * batch] for i in range(4)]
+    i = [0]
+
+    def step():
+        net.fit(xs[i[0] % 4], ys[i[0] % 4])
+        i[0] += 1
+
+    dt = _time_steps(step, 3, steps, lambda: jax.block_until_ready(net.params))
+    return {
+        "samples_per_sec": round(batch * steps / dt, 1),
+        "data": prov,
+        "batch": batch,
+    }
+
+
+def bench_torch_lenet_cpu(batch=512, steps=8):
+    """Reference-CPU baseline: LeNet-5 (same topology as models/lenet.py /
+    the dl4j LenetMnistExample) trained on torch-cpu. Stands in for the
+    nd4j-native CPU path of MultiLayerNetwork.fit :1017."""
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    model = nn.Sequential(
+        nn.Conv2d(1, 20, 5), nn.MaxPool2d(2),
+        nn.Conv2d(20, 50, 5), nn.MaxPool2d(2),
+        nn.Flatten(), nn.Linear(50 * 4 * 4, 500), nn.ReLU(),
+        nn.Linear(500, 10),
+    )
+    opt = torch.optim.SGD(model.parameters(), lr=0.01, momentum=0.9)
+    lossf = nn.CrossEntropyLoss()
+    x = torch.randn(batch, 1, 28, 28)
+    y = torch.randint(0, 10, (batch,))
+
+    def step():
+        opt.zero_grad()
+        lossf(model(x), y).backward()
+        opt.step()
+
+    dt = _time_steps(step, 2, steps, lambda: None)
+    return {"samples_per_sec": round(batch * steps / dt, 1), "batch": batch}
+
+
+# ---------------------------------------------------------------------------
+# configs[1]: char-RNN (LSTM) train + generation
+# ---------------------------------------------------------------------------
+
+
+def bench_char_rnn(batch=32, seq=100, vocab=80, lstm=200, steps=10):
+    import jax
+
+    from deeplearning4j_tpu.models.char_rnn import char_rnn_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(
+        char_rnn_conf(vocab, lstm_size=lstm, num_layers=2, tbptt_length=50)
+    ).init(input_shape=(1, vocab))
+    rng = np.random.default_rng(0)
+    eye = np.eye(vocab, dtype=np.float32)
+    ids = rng.integers(0, vocab, (batch, seq + 1))
+    x, y = eye[ids[:, :seq]], eye[ids[:, 1:]]
+
+    def step():
+        net.fit(x, y)  # 2 TBPTT windows of 50
+
+    dt = _time_steps(step, 2, steps, lambda: jax.block_until_ready(net.params))
+    train_samples = batch * steps / dt
+    train_tokens = train_samples * seq
+
+    # streaming generation throughput (reference rnnTimeStep :2152 hot path)
+    net.rnn_clear_previous_state()
+    x1 = eye[0][None, None, :]
+    gen_steps = 200
+    for _ in range(3):
+        net.rnn_time_step(x1)
+    out = None
+    t0 = time.perf_counter()
+    for _ in range(gen_steps):
+        out = net.rnn_time_step(x1)
+    jax.block_until_ready(out)
+    gen_dt = time.perf_counter() - t0
+    return {
+        "train_samples_per_sec": round(train_samples, 1),
+        "train_tokens_per_sec": round(train_tokens, 1),
+        "generation_chars_per_sec": round(gen_steps / gen_dt, 1),
+        "batch": batch, "seq": seq, "lstm": lstm,
+    }
+
+
+# ---------------------------------------------------------------------------
+# configs[2]: ResNet-50 + MFU
+# ---------------------------------------------------------------------------
+
+
+def _peak_flops_per_chip() -> float:
+    """bf16 peak for the local accelerator (MXU rate; f32 inputs hit the MXU
+    through bf16 passes under jax's DEFAULT matmul precision)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12  # conservative default
+
+
+def bench_resnet50(batch=64, steps=10, input_size=224):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.resnet import build_resnet50
+
+    net = build_resnet50(input_size=input_size, num_classes=1000,
+                         updater="nesterovs", learning_rate=0.05)
+    rng = np.random.default_rng(0)
+    x = rng.random((batch, input_size, input_size, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+
+    def step():
+        net.fit(x, y)
+
+    dt = _time_steps(step, 2, steps, lambda: jax.block_until_ready(net.params))
+    samples_per_sec = batch * steps / dt
+
+    # XLA-counted FLOPs of the whole compiled train step (fwd+bwd+update)
+    flops = None
+    try:
+        step_fn = net._get_train_step(1, False)
+        inputs = net._as_inputs(jnp.asarray(x))
+        labels = [jnp.asarray(y)]
+        from deeplearning4j_tpu.ops import rng as rng_mod
+
+        lowered = step_fn.lower(
+            net.params, net.states, net.updater_state, inputs, labels,
+            jnp.asarray(0, jnp.int32), rng_mod.step_key(net._rng, 0), {}, None,
+        )
+        cost = lowered.compile().cost_analysis()
+        if cost:
+            c = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops = float(c.get("flops", 0.0)) or None
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+    mfu = None
+    if flops:
+        # FLOPs per step / (seconds per step * peak FLOPs/sec)
+        mfu = (flops / (dt / steps)) / _peak_flops_per_chip()
+    return {
+        "samples_per_sec": round(samples_per_sec, 2),
+        "step_flops": flops,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch": batch, "input": input_size,
+    }
+
+
+# ---------------------------------------------------------------------------
+# configs[3]: Word2Vec skip-gram negative sampling
+# ---------------------------------------------------------------------------
+
+
+def bench_word2vec(vocab=2000, sentences=800, sent_len=40):
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    # zipf-ish corpus over a synthetic vocab
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    words = [f"w{i}" for i in range(vocab)]
+    corpus = [
+        [words[i] for i in rng.choice(vocab, size=sent_len, p=probs)]
+        for _ in range(sentences)
+    ]
+    w2v = Word2Vec(layer_size=128, window=5, negative=5, min_word_frequency=1,
+                   epochs=1, iterations=1, batch_size=2048, seed=1)
+    w2v.build_vocab(corpus)
+    seqs = w2v._sequences_as_indices(corpus)
+    centers, _ = w2v._make_pairs(seqs, np.random.default_rng(1))
+    pairs = len(centers)
+    t0 = time.perf_counter()
+    w2v.fit_tokens(corpus)  # includes XLA compile
+    cold_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    w2v.fit_tokens(corpus)  # steady state (the number that scales to real corpora)
+    warm_dt = time.perf_counter() - t0
+    return {
+        "pairs_per_sec": round(pairs / warm_dt, 1),
+        "pairs_per_sec_incl_compile": round(pairs / cold_dt, 1),
+        "pairs": int(pairs), "vocab": vocab,
+    }
+
+
+# ---------------------------------------------------------------------------
+# configs[4]: DP scaling on the virtual 8-device mesh (subprocess, CPU)
+# ---------------------------------------------------------------------------
+
+_SCALING_SCRIPT = r"""
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+from deeplearning4j_tpu.models.resnet import build_resnet50
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+batch, steps = 32, 4
+rng = np.random.default_rng(0)
+x = rng.random((batch, 32, 32, 3)).astype(np.float32)
+y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+
+def measure(n_dev):
+    net = build_resnet50(input_size=32, num_classes=10)
+    pw = ParallelWrapper(net, num_devices=n_dev)
+    pw.fit(x, y)  # compile
+    jax.block_until_ready(net.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        pw.fit(x, y)
+    jax.block_until_ready(net.params)
+    return batch * steps / (time.perf_counter() - t0)
+
+t1 = measure(1)
+t8 = measure(8)
+print(json.dumps({"throughput_1dev": round(t1, 2), "throughput_8dev": round(t8, 2),
+                  "dp_overhead_ratio": round(t8 / t1, 4)}))
+"""
+
+
+def bench_scaling():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _SCALING_SCRIPT],
+            capture_output=True, text=True, timeout=1200, env=env,
+        )
+        line = out.stdout.strip().splitlines()[-1]
+        res = json.loads(line)
+    except Exception as e:  # noqa: BLE001 — report, don't kill the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+    res["note"] = (
+        "equal-work DP overhead on the virtual 8-device CPU mesh of a "
+        "1-core host: ratio of 8-way-sharded to single-device throughput "
+        "at the SAME global batch (1.0 = zero partitioning/collective "
+        "overhead). Raw 1-to-8 scaling needs 8 real chips."
+    )
+    return res
+
+
+# ---------------------------------------------------------------------------
+# north star: 100-step CPU vs accelerator f32-strict curves
+# ---------------------------------------------------------------------------
+
+
+def bench_north_star(steps=100):
+    import jax
+
+    from deeplearning4j_tpu.utils.equivalence import run_north_star
+
+    res = run_north_star(steps=steps, artifact_path="NORTHSTAR_r.json")
+    return {
+        k: {
+            "max_abs_deviation": v["max_abs_deviation"],
+            "max_rel_deviation": v["max_rel_deviation"],
+            "final_loss_cpu": v["final_loss_cpu"],
+            "final_loss_accel": v["final_loss_accel"],
+            "backends": f"{v['backend_cpu']} vs {v['backend_accel']}",
+        }
+        for k, v in res.items()
+    }
 
 
 def main():
-    import jax
+    quick = "--quick" in sys.argv
+    only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--only=")]
+    _enable_compile_cache()
+    extras = {}
 
-    from deeplearning4j_tpu.models.lenet import build_lenet5
-    from deeplearning4j_tpu.datasets.fetchers import load_mnist
+    def run(name, fn, *a, **kw):
+        if only and name not in only:
+            return
+        _log(f"start {name}")
+        t0 = time.perf_counter()
+        try:
+            extras[name] = fn(*a, **kw)
+        except Exception as e:  # noqa: BLE001 — one broken bench must not kill the rest
+            _log(f"FAILED {name}: {type(e).__name__}: {e}")
+            extras[name] = {"error": f"{type(e).__name__}: {e}"}
+        _log(f"done {name} in {time.perf_counter() - t0:.1f}s")
 
-    batch = 512
-    warmup_steps = 3
-    bench_steps = 30
+    run("lenet5", bench_lenet, steps=10 if quick else 30)
+    run("reference_cpu_lenet5_torch", bench_torch_lenet_cpu,
+        steps=3 if quick else 8)
+    run("char_rnn", bench_char_rnn, steps=3 if quick else 10)
+    run("resnet50", bench_resnet50, steps=3 if quick else 10)
+    run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
+    run("scaling_virtual8", bench_scaling)
+    run("north_star", bench_north_star, steps=10 if quick else 100)
+    if only:
+        print(json.dumps(extras))
+        return
 
-    net = build_lenet5()
-    x, y = load_mnist(train=True, num_examples=batch * 4)
-    xs = [x[i * batch : (i + 1) * batch] for i in range(4)]
-    ys = [y[i * batch : (i + 1) * batch] for i in range(4)]
-
-    # warmup (compile)
-    for i in range(warmup_steps):
-        net.fit(xs[i % 4], ys[i % 4])
-    jax.block_until_ready(net.params)
-
-    t0 = time.perf_counter()
-    for i in range(bench_steps):
-        net.fit(xs[i % 4], ys[i % 4])
-    jax.block_until_ready(net.params)
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = batch * bench_steps / dt
-    vs = (
-        samples_per_sec / REFERENCE_CPU_SAMPLES_PER_SEC
-        if REFERENCE_CPU_SAMPLES_PER_SEC
-        else 1.0
-    )
+    headline = extras.get("lenet5", {}).get("samples_per_sec", 0.0)
+    ref = extras.get("reference_cpu_lenet5_torch", {}).get("samples_per_sec")
     print(
         json.dumps(
             {
                 "metric": "lenet5_mnist_train_throughput",
-                "value": round(samples_per_sec, 1),
+                "value": headline,
                 "unit": "samples/sec/chip",
-                "vs_baseline": round(vs, 3),
+                "vs_baseline": round(headline / ref, 3) if ref else 1.0,
+                "baseline_impl": "torch-cpu LeNet-5 (nd4j-native CPU stand-in)",
+                "extras": extras,
             }
         )
     )

@@ -76,6 +76,8 @@ class Glove:
         seed: int = 123,
         batch_size: int = 4096,
         tokenizer: Optional[DefaultTokenizerFactory] = None,
+        num_workers: Optional[int] = None,
+        mesh=None,
     ):
         self.layer_size = layer_size
         self.learning_rate = learning_rate
@@ -92,6 +94,21 @@ class Glove:
         self.W: Optional[np.ndarray] = None
         self.bias: Optional[np.ndarray] = None
         self.losses: List[float] = []
+        # data-parallel co-occurrence regression over a device mesh (role of
+        # dl4j-spark-nlp Glove + CoOccurrenceCalculator: partitioned pair
+        # batches against broadcast factors; here the pair batch is SHARDED
+        # and GSPMD inserts the psum of the AdaGrad scatter updates)
+        self.mesh = None
+        if mesh is not None or num_workers is not None:
+            from deeplearning4j_tpu.parallel.mesh import device_mesh
+
+            self.mesh = mesh if mesh is not None else device_mesh(num_workers)
+            n_dev = int(np.prod(self.mesh.devices.shape))
+            if self.batch_size % n_dev != 0:
+                raise ValueError(
+                    f"batch_size {self.batch_size} not divisible by "
+                    f"{n_dev} mesh devices"
+                )
 
     # -- co-occurrences ---------------------------------------------------
     def _count_cooccurrences(self, seqs: List[np.ndarray]) -> Dict[Tuple[int, int], float]:
@@ -142,10 +159,21 @@ class Glove:
 
         V, D = vocab.num_words(), self.layer_size
         rng = np.random.default_rng(self.seed)
-        W = jnp.asarray(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
-        b = jnp.zeros((V,), jnp.float32)
-        hW = jnp.full((V, D), 1e-8, jnp.float32)
-        hb = jnp.full((V,), 1e-8, jnp.float32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PSpec
+
+            from deeplearning4j_tpu.parallel.mesh import DATA_AXIS
+
+            data_sh = NamedSharding(self.mesh, PSpec(DATA_AXIS))
+            repl = NamedSharding(self.mesh, PSpec())
+            pb = lambda a: jax.device_put(jnp.asarray(a), data_sh)
+            pt = lambda a: jax.device_put(jnp.asarray(a), repl)
+        else:
+            pb = pt = jnp.asarray
+        W = pt(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
+        b = pt(np.zeros((V,), np.float32))
+        hW = pt(np.full((V, D), 1e-8, np.float32))
+        hb = pt(np.full((V,), 1e-8, np.float32))
 
         B = self.batch_size
         n = len(pairs)
@@ -160,9 +188,9 @@ class Glove:
                 live = (np.arange(B) < m).astype(np.float32)
                 W, b, hW, hb, loss = _glove_step(
                     W, b, hW, hb,
-                    jnp.asarray(pairs[sel, 0]), jnp.asarray(pairs[sel, 1]),
-                    jnp.asarray(logx[sel]), jnp.asarray(fdiff[sel]),
-                    jnp.float32(self.learning_rate), jnp.asarray(live),
+                    pb(pairs[sel, 0]), pb(pairs[sel, 1]),
+                    pb(logx[sel]), pb(fdiff[sel]),
+                    jnp.float32(self.learning_rate), pb(live),
                 )
                 epoch_loss += float(loss)
             self.losses.append(epoch_loss / n)

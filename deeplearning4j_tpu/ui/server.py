@@ -56,12 +56,28 @@ class HistoryStorage:
 
 
 class UiServer:
-    """POST /train/update  {type: score|histogram|flow, ...}
-    GET  /train/summary   JSON dump of latest state
-    GET  /                server-rendered dashboard"""
+    """POST /train/update      {type: score|histogram|flow, ...}
+    GET  /train/summary        JSON dump of latest state
+    GET  /                     server-rendered dashboard
+
+    Explorer resources (reference ui/tsne/TsneResource.java and
+    ui/nearestneighbors/word2vec/NearestNeighborsResource.java):
+    POST /tsne/upload          {words:[...], vectors:[[...]]} -> run t-SNE
+    POST /tsne/update          {words:[...], coords:[[x,y]...]} (precomputed)
+    GET  /tsne/coords          stored 2-d coordinates as JSON
+    GET  /tsne                 server-rendered scatter page
+    POST /word2vec/upload      {words:[...], vectors:[[...]]} -> build VPTree
+    GET  /word2vec/words       vocab list (reference /vocab)
+    POST /word2vec/nearest     {word: w, k: n} | {vector: [...], k: n}"""
 
     def __init__(self, port: int = 0, storage: Optional[HistoryStorage] = None):
         self.storage = storage or HistoryStorage()
+        # explorer state (uploaded embeddings / computed coordinates)
+        self._tsne_words: List[str] = []
+        self._tsne_coords: List[List[float]] = []
+        self._nn_words: List[str] = []
+        self._nn_vectors = None
+        self._nn_tree = None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -75,27 +91,60 @@ class UiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_json(self, code: int, obj) -> None:
+                self._send(code, json.dumps(obj).encode(), "application/json")
+
             def do_POST(self):
-                if self.path != "/train/update":
-                    self._send(404, b"not found", "text/plain")
-                    return
                 n = int(self.headers.get("Content-Length", 0))
                 try:
                     payload = json.loads(self.rfile.read(n))
-                    key = payload.get("type", "unknown")
-                    server.storage.put(key, payload)
-                    self._send(200, b'{"ok":true}', "application/json")
-                except (ValueError, KeyError) as e:
-                    self._send(400, str(e).encode(), "text/plain")
+                    if self.path == "/train/update":
+                        key = payload.get("type", "unknown")
+                        server.storage.put(key, payload)
+                        self._send_json(200, {"ok": True})
+                    elif self.path == "/tsne/upload":
+                        count = server.tsne_upload(
+                            payload["words"], payload["vectors"],
+                            **{
+                                k: payload[k]
+                                for k in ("perplexity", "iterations")
+                                if k in payload
+                            },
+                        )
+                        self._send_json(200, {"ok": True, "points": count})
+                    elif self.path == "/tsne/update":
+                        server.tsne_update(payload["words"], payload["coords"])
+                        self._send_json(200, {"ok": True})
+                    elif self.path == "/word2vec/upload":
+                        count = server.nn_upload(
+                            payload["words"], payload["vectors"]
+                        )
+                        self._send_json(200, {"ok": True, "words": count})
+                    elif self.path == "/word2vec/nearest":
+                        self._send_json(200, server.nn_query(payload))
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except (ValueError, KeyError, TypeError) as e:
+                    self._send_json(400, {"error": f"{type(e).__name__}: {e}"})
 
             def do_GET(self):
                 if self.path == "/train/summary":
                     out = {
                         k: server.storage.latest(k) for k in server.storage.keys()
                     }
-                    self._send(
-                        200, json.dumps(out).encode(), "application/json"
+                    self._send_json(200, out)
+                elif self.path == "/tsne/coords":
+                    self._send_json(
+                        200,
+                        {"words": server._tsne_words,
+                         "coords": server._tsne_coords},
                     )
+                elif self.path == "/tsne":
+                    self._send(
+                        200, server.render_tsne().encode(), "text/html"
+                    )
+                elif self.path == "/word2vec/words":
+                    self._send_json(200, {"words": server._nn_words})
                 elif self.path == "/":
                     self._send(
                         200, server.render_dashboard().encode(), "text/html"
@@ -106,6 +155,88 @@ class UiServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    # -- explorer backends -------------------------------------------------
+    def tsne_upload(self, words, vectors, perplexity: float = 30.0,
+                    iterations: int = 300) -> int:
+        """Run t-SNE on uploaded embeddings and store the scatter coords
+        (reference TsneResource.handleUpload -> Tsne pipeline)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.plot.tsne import Tsne
+
+        x = np.asarray(vectors, dtype=np.float32)
+        if x.ndim != 2 or x.shape[0] != len(words):
+            raise ValueError("vectors must be [len(words), dim]")
+        perplexity = min(perplexity, max(2.0, (x.shape[0] - 1) / 3.0))
+        coords = Tsne(
+            n_components=2, perplexity=perplexity, max_iter=int(iterations)
+        ).fit_transform(x)
+        self.tsne_update(list(words), np.asarray(coords).tolist())
+        return len(self._tsne_words)
+
+    def tsne_update(self, words, coords) -> None:
+        """Store precomputed coordinates (reference postCoordinates :72)."""
+        if len(words) != len(coords):
+            raise ValueError("words/coords length mismatch")
+        self._tsne_words = list(words)
+        self._tsne_coords = [[float(c[0]), float(c[1])] for c in coords]
+
+    def nn_upload(self, words, vectors) -> int:
+        """Build the VPTree over uploaded word vectors (reference
+        NearestNeighborsResource upload -> VPTree build)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.clustering.vptree import VPTree
+
+        x = np.asarray(vectors, dtype=np.float32)
+        if x.ndim != 2 or x.shape[0] != len(words):
+            raise ValueError("vectors must be [len(words), dim]")
+        self._nn_words = list(words)
+        self._nn_vectors = x
+        self._nn_tree = VPTree(x, distance="cosine")
+        return len(words)
+
+    def nn_query(self, payload) -> Dict[str, Any]:
+        """k nearest neighbors by word or raw vector (reference
+        NearestNeighborsResource.getWords)."""
+        import numpy as np
+
+        if self._nn_tree is None:
+            raise ValueError("no word vectors uploaded")
+        k = int(payload.get("k", 10))
+        if "word" in payload:
+            word = payload["word"]
+            if word not in self._nn_words:
+                raise ValueError(f"unknown word {word!r}")
+            qi = self._nn_words.index(word)
+            q = self._nn_vectors[qi]
+            skip = qi
+        else:
+            q = np.asarray(payload["vector"], np.float32)
+            skip = -1
+        hits = self._nn_tree.knn(q, k + (1 if skip >= 0 else 0))
+        out = [
+            {"word": self._nn_words[i], "distance": float(d)}
+            for d, i in hits
+            if i != skip
+        ][:k]
+        return {"neighbors": out}
+
+    def render_tsne(self) -> str:
+        from deeplearning4j_tpu.ui.components import ChartScatter
+
+        if not self._tsne_coords:
+            return render_page(
+                [ComponentText(text="no t-SNE coordinates uploaded yet — "
+                               "POST /tsne/upload or /tsne/update")],
+                title="t-SNE explorer",
+            )
+        chart = ChartScatter(title=f"t-SNE ({len(self._tsne_words)} points)")
+        xs = [c[0] for c in self._tsne_coords]
+        ys = [c[1] for c in self._tsne_coords]
+        chart.add_series("words", xs, ys)
+        return render_page([chart], title="t-SNE explorer")
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "UiServer":

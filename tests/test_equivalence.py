@@ -1,0 +1,55 @@
+"""North-star equivalence harness tests (BASELINE.json north_star; SURVEY.md
+§7 "Hard parts"). On the CPU-only test environment the CPU-vs-default
+comparison degenerates to a two-run determinism check: curves must match
+EXACTLY (bitwise) — the strongest form of the bar, validating that RNG
+streams and compiled programs are reproducible. The real CPU-vs-TPU
+deviation is measured by bench.py on hardware."""
+
+import numpy as np
+
+from deeplearning4j_tpu.utils.equivalence import (
+    char_batches,
+    compare_backends,
+    loss_curve,
+    mnist_batches,
+)
+
+
+def _lenet_builder():
+    from deeplearning4j_tpu.models.lenet import build_lenet5
+
+    return build_lenet5(seed=12345)
+
+
+def test_lenet_curve_deterministic_and_decreasing():
+    batches = mnist_batches(n_steps=12, batch=32)
+    res = compare_backends(_lenet_builder, batches)
+    assert res["same_backend"]  # cpu test env
+    assert res["max_abs_deviation"] == 0.0, res  # bitwise reproducible
+    curve = np.asarray(res["curve_cpu"])
+    assert curve[-1] < curve[0], "loss did not decrease over 12 steps"
+
+
+def test_char_rnn_curve_deterministic():
+    from deeplearning4j_tpu.models.char_rnn import char_rnn_conf
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    def builder():
+        return MultiLayerNetwork(
+            char_rnn_conf(20, lstm_size=16, num_layers=1, seed=3,
+                          tbptt_length=8)
+        ).init(input_shape=(1, 20))
+
+    res = compare_backends(builder, char_batches(n_steps=6, batch=8, seq=16, vocab=20))
+    assert res["max_abs_deviation"] == 0.0, res
+
+
+def test_matmul_precision_context_applies():
+    """float32-strict vs default precision produce (at minimum) a valid
+    curve each; on CPU both are f32 so they agree — the context must not
+    break compilation."""
+    batches = mnist_batches(n_steps=3, batch=16)
+    c_strict = loss_curve(_lenet_builder, batches, matmul_precision="float32")
+    c_native = loss_curve(_lenet_builder, batches, matmul_precision=None)
+    assert np.isfinite(c_strict).all() and np.isfinite(c_native).all()
+    np.testing.assert_allclose(c_strict, c_native, rtol=1e-6)
