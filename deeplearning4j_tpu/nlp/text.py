@@ -114,6 +114,39 @@ class NGramTokenizerFactory:
         return self.create(text).get_tokens()
 
 
+class PosFilterTokenizerFactory:
+    """PoS-filtering tokenizer (reference PosUimaTokenizerFactory.java:
+    tokens whose predicted part-of-speech is not in ``allowed_tags`` are
+    replaced by "NONE" so positional structure is preserved). The UIMA
+    PosTagger annotator is replaced by the in-repo
+    :class:`~deeplearning4j_tpu.nlp.treeparser.AveragedPerceptronTagger`."""
+
+    PLACEHOLDER = "NONE"
+
+    def __init__(self, allowed_tags: Sequence[str], tagger=None,
+                 base: Optional[DefaultTokenizerFactory] = None,
+                 drop: bool = False):
+        from deeplearning4j_tpu.nlp.treeparser import AveragedPerceptronTagger
+
+        self.allowed = set(allowed_tags)
+        self.tagger = tagger or AveragedPerceptronTagger()
+        self.base = base or DefaultTokenizerFactory()
+        self.drop = drop  # True: remove instead of placeholder
+
+    def create(self, text: str) -> Tokenizer:
+        words = self.base.tokenize(text)
+        tags = self.tagger.tag(words)
+        if self.drop:
+            kept = [w for w, t in zip(words, tags) if t in self.allowed]
+        else:
+            kept = [w if t in self.allowed else self.PLACEHOLDER
+                    for w, t in zip(words, tags)]
+        return Tokenizer(kept)
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()
+
+
 # ---------------------------------------------------------------------------
 # Sentence iterators
 # ---------------------------------------------------------------------------

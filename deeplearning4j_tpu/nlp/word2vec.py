@@ -136,10 +136,9 @@ def _cbow_body(syn0, syn1, ctx_idx, ctx_mask, points, codes, mask, alpha):
     return syn0, syn1
 
 
-# per-batch jitted steps (kept for tests / incremental use)
+# per-batch jitted HS step (used by graph/deepwalk.py and its tests; the
+# NS/CBOW bodies run only inside the fused epoch scans below)
 _skipgram_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_body)
-_skipgram_neg_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_neg_body)
-_cbow_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_cbow_body)
 
 
 # ---------------------------------------------------------------------------
@@ -534,21 +533,6 @@ class Word2Vec:
         return max(
             self.min_learning_rate, self.learning_rate * (1.0 - progress)
         )
-
-    def _draw_negatives(self, centers: np.ndarray, rng: np.random.Generator):
-        """targets (B,K+1): col 0 = center (label 1), others drawn from the
-        unigram table (SkipGram.java:218-230); collisions with the center are
-        masked out rather than `continue`d."""
-        K = self.negative
-        B = len(centers)
-        table = self.lookup_table.table
-        draws = table[rng.integers(0, len(table), size=(B, K))]
-        targets = np.concatenate([centers[:, None], draws], axis=1).astype(np.int32)
-        labels = np.zeros((B, K + 1), np.float32)
-        labels[:, 0] = 1.0
-        live = np.ones((B, K + 1), np.float32)
-        live[:, 1:] = (draws != centers[:, None]).astype(np.float32)
-        return targets, labels, live
 
     # -- query API (Word2Vec.java surface) --------------------------------
     def get_word_vector(self, word: str) -> Optional[np.ndarray]:

@@ -73,11 +73,11 @@ class UiServer:
     def __init__(self, port: int = 0, storage: Optional[HistoryStorage] = None):
         self.storage = storage or HistoryStorage()
         # explorer state (uploaded embeddings / computed coordinates)
-        self._tsne_words: List[str] = []
-        self._tsne_coords: List[List[float]] = []
-        self._nn_words: List[str] = []
-        self._nn_vectors = None
-        self._nn_tree = None
+        # explorer state published as single atomic tuples — handler
+        # threads snapshot once so words/coords (and words/vectors/tree)
+        # can never be observed mid-replacement
+        self._tsne_state: tuple = ([], [])  # (words, coords)
+        self._nn_state = None  # (words, vectors, VPTree) | None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -134,17 +134,17 @@ class UiServer:
                     }
                     self._send_json(200, out)
                 elif self.path == "/tsne/coords":
-                    self._send_json(
-                        200,
-                        {"words": server._tsne_words,
-                         "coords": server._tsne_coords},
-                    )
+                    words, coords = server._tsne_state
+                    self._send_json(200, {"words": words, "coords": coords})
                 elif self.path == "/tsne":
                     self._send(
                         200, server.render_tsne().encode(), "text/html"
                     )
                 elif self.path == "/word2vec/words":
-                    self._send_json(200, {"words": server._nn_words})
+                    state = server._nn_state
+                    self._send_json(
+                        200, {"words": state[0] if state else []}
+                    )
                 elif self.path == "/":
                     self._send(
                         200, server.render_dashboard().encode(), "text/html"
@@ -173,14 +173,15 @@ class UiServer:
             n_components=2, perplexity=perplexity, max_iter=int(iterations)
         ).fit_transform(x)
         self.tsne_update(list(words), np.asarray(coords).tolist())
-        return len(self._tsne_words)
+        return len(self._tsne_state[0])
 
     def tsne_update(self, words, coords) -> None:
         """Store precomputed coordinates (reference postCoordinates :72)."""
         if len(words) != len(coords):
             raise ValueError("words/coords length mismatch")
-        self._tsne_words = list(words)
-        self._tsne_coords = [[float(c[0]), float(c[1])] for c in coords]
+        # single atomic swap: handler threads read (words, coords) as a pair
+        coords = [[float(c[0]), float(c[1])] for c in coords]
+        self._tsne_state = (list(words), coords)
 
     def nn_upload(self, words, vectors) -> int:
         """Build the VPTree over uploaded word vectors (reference
@@ -192,9 +193,10 @@ class UiServer:
         x = np.asarray(vectors, dtype=np.float32)
         if x.ndim != 2 or x.shape[0] != len(words):
             raise ValueError("vectors must be [len(words), dim]")
-        self._nn_words = list(words)
-        self._nn_vectors = x
-        self._nn_tree = VPTree(x, distance="cosine")
+        # build off to the side, publish as ONE tuple: concurrent nn_query
+        # on the ThreadingHTTPServer must never see a new word list paired
+        # with an old tree (index-out-of-range / wrong labels)
+        self._nn_state = (list(words), x, VPTree(x, distance="cosine"))
         return len(words)
 
     def nn_query(self, payload) -> Dict[str, Any]:
@@ -202,22 +204,24 @@ class UiServer:
         NearestNeighborsResource.getWords)."""
         import numpy as np
 
-        if self._nn_tree is None:
+        state = self._nn_state  # snapshot: words/vectors/tree stay coherent
+        if state is None:
             raise ValueError("no word vectors uploaded")
+        nn_words, nn_vectors, nn_tree = state
         k = int(payload.get("k", 10))
         if "word" in payload:
             word = payload["word"]
-            if word not in self._nn_words:
+            if word not in nn_words:
                 raise ValueError(f"unknown word {word!r}")
-            qi = self._nn_words.index(word)
-            q = self._nn_vectors[qi]
+            qi = nn_words.index(word)
+            q = nn_vectors[qi]
             skip = qi
         else:
             q = np.asarray(payload["vector"], np.float32)
             skip = -1
-        hits = self._nn_tree.knn(q, k + (1 if skip >= 0 else 0))
+        hits = nn_tree.knn(q, k + (1 if skip >= 0 else 0))
         out = [
-            {"word": self._nn_words[i], "distance": float(d)}
+            {"word": nn_words[i], "distance": float(d)}
             for d, i in hits
             if i != skip
         ][:k]
@@ -226,15 +230,16 @@ class UiServer:
     def render_tsne(self) -> str:
         from deeplearning4j_tpu.ui.components import ChartScatter
 
-        if not self._tsne_coords:
+        tsne_words, tsne_coords = self._tsne_state
+        if not tsne_coords:
             return render_page(
                 [ComponentText(text="no t-SNE coordinates uploaded yet — "
                                "POST /tsne/upload or /tsne/update")],
                 title="t-SNE explorer",
             )
-        chart = ChartScatter(title=f"t-SNE ({len(self._tsne_words)} points)")
-        xs = [c[0] for c in self._tsne_coords]
-        ys = [c[1] for c in self._tsne_coords]
+        chart = ChartScatter(title=f"t-SNE ({len(tsne_words)} points)")
+        xs = [c[0] for c in tsne_coords]
+        ys = [c[1] for c in tsne_coords]
         chart.add_series("words", xs, ys)
         return render_page([chart], title="t-SNE explorer")
 

@@ -45,12 +45,13 @@ class DiskBasedQueue:
         return item
 
     def peek(self) -> Optional[Any]:
+        # read under the lock: a concurrent poll() unlinks the head file
+        # right after releasing it, so reading outside would race
         with self._lock:
             if not self._paths:
                 return None
-            path = self._paths[0]
-        with open(path, "rb") as f:
-            return pickle.load(f)  # noqa: S301
+            with open(self._paths[0], "rb") as f:
+                return pickle.load(f)  # noqa: S301
 
     def __len__(self) -> int:
         with self._lock:

@@ -70,8 +70,25 @@ def compare_backends(
         batches = batches[:steps]
     cpu = jax.local_devices(backend="cpu")[0]
     default_dev = jax.devices()[0]
-    curve_cpu = loss_curve(net_builder, batches, device=cpu)
-    curve_acc = loss_curve(net_builder, batches, device=default_dev)
+
+    def curve_with_retry(device, attempts=3):
+        # the remote-TPU tunnel can drop mid-run (UNAVAILABLE /
+        # "transport ... Unexpected EOF"); the run is deterministic, so a
+        # clean retry is sound
+        import time as _time
+
+        for i in range(attempts):
+            try:
+                return loss_curve(net_builder, batches, device=device)
+            except Exception as e:  # noqa: BLE001 — retry only transient infra errors
+                msg = str(e)
+                if ("UNAVAILABLE" not in msg and "transport" not in msg.lower()) \
+                        or i == attempts - 1:
+                    raise
+                _time.sleep(5.0 * (i + 1))
+
+    curve_cpu = curve_with_retry(cpu)
+    curve_acc = curve_with_retry(default_dev)
     abs_dev = np.abs(curve_acc - curve_cpu)
     denom = np.maximum(np.abs(curve_cpu), 1e-12)
     return {

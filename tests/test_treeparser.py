@@ -1,0 +1,188 @@
+"""Tests for nlp/treeparser.py — constituency trees, PoS tagging, PCFG/CKY
+parsing (reference text/corpora/treeparser/* + recursive/Tree.java)."""
+
+import pytest
+
+from deeplearning4j_tpu.nlp.treeparser import (
+    AveragedPerceptronTagger,
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    HeadWordFinder,
+    Pcfg,
+    Tree,
+    TreeIterator,
+    TreeParser,
+    TreeVectorizer,
+    parse_sexpr,
+)
+
+SEXPR = "(S (NP (DT the) (NN dog)) (VP (VBZ chases) (NP (DT the) (NN cat))))"
+
+
+def test_sexpr_roundtrip():
+    t = parse_sexpr(SEXPR)
+    assert t.to_sexpr() == SEXPR
+    assert t.yield_() == ["the", "dog", "chases", "the", "cat"]
+    assert t.tokens == t.yield_()
+
+
+def test_tree_structure_queries():
+    t = parse_sexpr(SEXPR)
+    assert t.label == "S"
+    assert not t.is_leaf()
+    assert t.depth() == 4  # S -> VP -> NP -> NN -> leaf
+    np_node = t.first_child()
+    assert np_node.label == "NP"
+    dt = np_node.first_child()
+    assert dt.is_preterminal()
+    assert dt.first_child().is_leaf()
+    assert len(t.leaves()) == 5
+    assert len(t.preterminals()) == 5
+    # parent links + ancestor
+    assert dt.parent is np_node
+    assert dt.ancestor(2) is t
+    # clone is deep + equal by structure
+    c = t.clone()
+    assert c == t and c is not t
+    c.first_child().label = "XP"
+    assert c != t
+
+
+def test_error_sum():
+    t = parse_sexpr("(A (B b) (C c))")
+    t.error = 1.0
+    t.children[0].error = 2.0
+    t.children[1].error = 0.5
+    assert t.error_sum() == pytest.approx(3.5)
+
+
+def test_binarize_and_unbinarize():
+    t = parse_sexpr("(NP (DT the) (JJ big) (JJ red) (NN dog))")
+    b = BinarizeTreeTransformer()
+    bt = b.transform(t)
+    for node in bt.subtrees():
+        assert len(node.children) <= 2
+    # yield preserved, and inverse recovers the original
+    assert bt.yield_() == t.yield_()
+    assert b.unbinarize(bt) == t
+
+
+def test_collapse_unaries():
+    t = parse_sexpr("(S (NP (NX (NN dog))) (VP (VBZ runs)))")
+    ct = CollapseUnaries().transform(t)
+    # NP->NX chain collapsed to NP over the preterminal
+    assert ct.to_sexpr() == "(S (NP (NN dog)) (VP (VBZ runs)))"
+
+
+def test_head_word_finder():
+    t = parse_sexpr(SEXPR)
+    h = HeadWordFinder()
+    assert h.find_head(t).label == "VP"  # S -> VP
+    np_node = t.first_child()
+    assert h.find_head(np_node).label == "NN"  # NP -> NN
+    assert h.head_word(t) == "chases"
+    assert h.head_word(np_node) == "dog"
+    h.annotate(t)
+    assert t.head_word == "chases"
+
+
+def test_rule_tagger_untrained():
+    tags = AveragedPerceptronTagger().tag(
+        ["The", "dog", "quickly", "jumped", "over", "3", "fences"]
+    )
+    assert tags[0] == "DT"
+    assert tags[2] == "RB"
+    assert tags[3] == "VBD"
+    assert tags[5] == "CD"
+    assert tags[6] == "NNS"
+
+
+def test_perceptron_tagger_learns():
+    corpus = [
+        [("the", "DT"), ("dog", "NN"), ("barks", "VBZ")],
+        [("a", "DT"), ("cat", "NN"), ("sleeps", "VBZ")],
+        [("the", "DT"), ("cat", "NN"), ("barks", "VBZ")],
+        [("dogs", "NNS"), ("bark", "VBP")],
+        [("cats", "NNS"), ("sleep", "VBP")],
+        [("the", "DT"), ("big", "JJ"), ("dog", "NN"), ("sleeps", "VBZ")],
+        [("a", "DT"), ("small", "JJ"), ("cat", "NN"), ("runs", "VBZ")],
+    ] * 3
+    tagger = AveragedPerceptronTagger().train(corpus, iterations=8, seed=1)
+    assert tagger.tag(["the", "dog", "sleeps"]) == ["DT", "NN", "VBZ"]
+    assert tagger.tag(["a", "big", "cat", "barks"]) == ["DT", "JJ", "NN", "VBZ"]
+
+
+def test_pcfg_cky_recovers_bracketing():
+    bank = [
+        parse_sexpr("(S (NP (DT the) (NN dog)) (VP (VBZ chases) (NP (DT the) (NN cat))))"),
+        parse_sexpr("(S (NP (DT a) (NN cat)) (VP (VBZ sees) (NP (DT a) (NN bird))))"),
+        parse_sexpr("(S (NP (DT the) (NN bird)) (VP (VBZ sings)))"),
+    ]
+    g = Pcfg.from_trees(bank)
+    tree = g.parse(["DT", "NN", "VBZ", "DT", "NN"],
+                   ["the", "fox", "chases", "a", "hen"])
+    assert tree is not None
+    assert tree.label == "S"
+    assert tree.to_sexpr() == (
+        "(S (NP (DT the) (NN fox)) (VP (VBZ chases) (NP (DT a) (NN hen))))"
+    )
+    # single-word VP from the third tree's unary-free binary shape
+    t2 = g.parse(["DT", "NN", "VBZ"], ["a", "dog", "sings"])
+    assert t2 is not None and t2.label == "S"
+
+
+def test_treeparser_chunker_fallback():
+    parser = TreeParser()
+    trees = parser.get_trees("The big dog chased the cat. A bird sings.")
+    assert len(trees) == 2
+    t = trees[0]
+    assert t.label == "S"
+    labels = [c.label for c in t.children]
+    assert "NP" in labels and "VP" in labels
+    assert t.yield_()[:3] == ["The", "big", "dog"]
+
+
+def test_treeparser_with_grammar():
+    bank = [
+        parse_sexpr("(S (NP (DT the) (NN dog)) (VP (VBZ chases) (NP (DT the) (NN cat))))"),
+        parse_sexpr("(S (NP (DT a) (NN cat)) (VP (VBZ sees) (NP (DT a) (NN bird))))"),
+    ]
+    corpus = [
+        [("the", "DT"), ("dog", "NN"), ("chases", "VBZ"), ("the", "DT"), ("cat", "NN")],
+        [("a", "DT"), ("cat", "NN"), ("sees", "VBZ"), ("a", "DT"), ("bird", "NN")],
+    ] * 4
+    tagger = AveragedPerceptronTagger().train(corpus, iterations=6)
+    parser = TreeParser(tagger=tagger).fit_grammar(bank)
+    trees = parser.get_trees("the dog sees the bird.")
+    assert len(trees) == 1
+    assert trees[0].label == "S"
+    assert trees[0].first_child().label == "NP"
+
+
+def test_tree_vectorizer_labels():
+    v = TreeVectorizer()
+    trees = v.get_trees_with_labels("The dog runs.", "pos", ["NEG", "POS"])
+    assert len(trees) == 1
+    for node in trees[0].subtrees():
+        assert node.gold_label == 1
+
+
+def test_tree_iterator_batches():
+    docs = [("The dog runs. The cat sleeps.", "POS"), ("A bird sings.", "NEG")]
+    it = TreeIterator(docs, ["NEG", "POS"], batch_size=2)
+    batches = list(it)
+    total = sum(len(b) for b in batches)
+    assert total == 3
+    assert all(len(b) <= 2 for b in batches)
+    first = batches[0][0]
+    assert first.gold_label == 1
+
+
+def test_pos_filter_tokenizer():
+    from deeplearning4j_tpu.nlp.text import PosFilterTokenizerFactory
+
+    tf = PosFilterTokenizerFactory(["NN", "NNS"])
+    toks = tf.tokenize("the dog chased cats")
+    assert toks == ["NONE", "dog", "NONE", "cats"]
+    tf_drop = PosFilterTokenizerFactory(["NN", "NNS"], drop=True)
+    assert tf_drop.tokenize("the dog chased cats") == ["dog", "cats"]
