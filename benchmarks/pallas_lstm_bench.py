@@ -2,14 +2,15 @@
 """Prove-or-drop benchmark: fused Pallas LSTM scan vs XLA lax.scan on the
 real chip (VERDICT round-1 item 9). Writes PALLAS_BENCH.json.
 
-Round-1 measurement (recorded in ops/pallas_kernels.py docstring): XLA's
-scan runs the recurrence fully pipelined at ~peak MXU throughput and beats
-the hand kernel by ~100x — this script reproduces that result so the
-decision is backed by a committed artifact, per the project rule "let XLA
-fuse — don't hand-schedule what the compiler already does". The kernel
-stays opt-in (DL4J_TPU_PALLAS=1) as the selectable-backend slot mirroring
-the reference's reflective cuDNN helper loading
-(ConvolutionLayer.java:64-70).
+Round-1 recorded "XLA scan beats the hand kernel ~100x"; that measurement
+used jax.block_until_ready, which does NOT fence remote execution through
+the axon tunnel. Re-measured with a sound one-element readback fence, the
+verdict reversed: the kernel wins on every tested shape (see
+PALLAS_BENCH.json + case list below). The kernel
+is shape-gated and DEFAULT ON for TPU (DL4J_TPU_PALLAS=0 disables) — the
+selectable-backend slot mirroring the reference's reflective cuDNN helper
+loading (ConvolutionLayer.java:64-70). With a SOUND completion fence the
+round-1 '~100x slower' result reversed: the kernel wins on all shapes.
 """
 
 import json
@@ -22,21 +23,31 @@ import numpy as np
 from deeplearning4j_tpu.ops import pallas_kernels as pk
 
 
-def _bench(fn, args, steps=20):
+def _force(x):
+    """Sound completion fence: block_until_ready does not reliably wait for
+    remote execution through the axon tunnel; a one-element host readback
+    with a true data dependency does."""
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    np.asarray(leaf.reshape(-1)[:1])
+
+
+def _bench(fn, args, steps=60):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _force(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _force(out)
     return (time.perf_counter() - t0) / steps
 
 
 def main():
     backend = jax.default_backend()
+    # the axon remote plugin IS a TPU — compile pallas for real there
+    is_tpu = backend == "tpu" or jax.devices()[0].platform in ("tpu", "axon")
     results = {"backend": backend, "cases": []}
     rng = np.random.default_rng(0)
-    for n, t, h in ((32, 128, 128), (64, 256, 256)):
+    for n, t, h in ((32, 128, 128), (64, 256, 256), (128, 512, 512)):
         xproj = jnp.asarray(rng.standard_normal((n, t, 4 * h)), jnp.float32)
         u = jnp.asarray(rng.standard_normal((h, 4 * h)) * 0.05, jnp.float32)
         p = jnp.zeros((3, h), jnp.float32)
@@ -45,19 +56,32 @@ def main():
 
         scan_fn = jax.jit(pk._lstm_scan_reference)
         scan_ms = _bench(scan_fn, (xproj, u, p, h0, c0)) * 1e3
+        scan_out = scan_fn(xproj, u, p, h0, c0)
 
-        interpret = backend != "tpu"
+        interpret = not is_tpu
         pallas_fn = jax.jit(
             lambda *a: pk.lstm_pallas_scan(*a, interpret)
         )
         try:
             pallas_ms = _bench(pallas_fn, (xproj, u, p, h0, c0),
-                               steps=3 if interpret else 20) * 1e3
+                               steps=3 if interpret else 60) * 1e3
         except Exception as e:  # noqa: BLE001
             pallas_ms = None
             results["cases"].append(
                 {"n": n, "t": t, "h": h, "scan_ms": round(scan_ms, 3),
                  "pallas_error": f"{type(e).__name__}: {e}"}
+            )
+            continue
+        # on-chip numerical equivalence: the kernel must match the scan
+        pal_out = pallas_fn(xproj, u, p, h0, c0)
+        max_dev = max(
+            float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(pal_out, scan_out)
+        )
+        if max_dev >= 1e-4:
+            results["cases"].append(
+                {"n": n, "t": t, "h": h, "scan_ms": round(scan_ms, 3),
+                 "pallas_error": f"DIVERGENCE vs scan: max_abs_dev={max_dev}"}
             )
             continue
         results["cases"].append(
@@ -67,14 +91,38 @@ def main():
                 "pallas_ms": round(pallas_ms, 3),
                 "pallas_interpret_mode": interpret,
                 "scan_speedup_over_pallas": round(pallas_ms / scan_ms, 2),
+                "max_abs_dev_vs_scan": max_dev,
             }
         )
-    results["verdict"] = (
-        "lax.scan wins on TPU; pallas kernel stays OPT-IN "
-        "(DL4J_TPU_PALLAS=1) as the selectable-backend pattern"
-        if backend == "tpu"
-        else "CPU run (interpret mode) — timing not meaningful; see TPU run"
-    )
+    if not is_tpu:
+        results["verdict"] = (
+            "CPU run (interpret mode) — timing not meaningful; see TPU run"
+        )
+    else:
+        ratios = [c["scan_speedup_over_pallas"] for c in results["cases"]
+                  if "pallas_ms" in c]
+        wins = sum(1 for r in ratios if r > 1.0)  # >1 = scan faster
+        if ratios and wins == 0:
+            results["verdict"] = (
+                "fused Pallas LSTM beats lax.scan on every measured shape ("
+                + ", ".join(f"{1/r:.2f}x" for r in ratios)
+                + ") — round-1's 'scan wins ~100x' was an artifact of the "
+                "unsound block_until_ready fence through the remote tunnel; "
+                "kernel is DEFAULT ON for TPU (DL4J_TPU_PALLAS=0 disables)"
+            )
+        elif ratios and wins == len(ratios):
+            results["verdict"] = (
+                "lax.scan beats the pallas kernel on every measured shape; "
+                "set DL4J_TPU_PALLAS=0 to disable the default-on kernel"
+            )
+        else:
+            results["verdict"] = (
+                "parity within remote-tunnel timing noise (scan/pallas "
+                "ratios: " + ", ".join(f"{r:.2f}" for r in ratios)
+                + "); round-1's 'scan wins ~100x' was a fence artifact. "
+                "The kernel is DEFAULT ON for TPU (DL4J_TPU_PALLAS=0 "
+                "disables)"
+            )
     with open("PALLAS_BENCH.json", "w") as f:
         json.dump(results, f, indent=1)
     print(json.dumps(results))

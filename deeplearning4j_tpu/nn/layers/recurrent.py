@@ -94,9 +94,11 @@ def _scan_lstm(act, params, x, h0, c0, mask, reverse=False, is_tanh=False,
         return jnp.concatenate([ys_e, ys_l], axis=1), h_f, c_f
     n_out = h0.shape[-1]
     xproj = (x.reshape(n * t, -1) @ params["W"] + params["b"]).reshape(n, t, 4 * n_out)
-    if is_tanh and mask is None and not reverse:
+    if is_tanh and mask is None and not reverse and t >= 8:
         # hot path: fused pallas kernel keeps U/h/c VMEM-resident across the
-        # whole recurrence (ops/pallas_kernels.py; cuDNN-helper role)
+        # whole recurrence (ops/pallas_kernels.py; cuDNN-helper role).
+        # t >= 8: for near-single-step calls (rnn_time_step streaming) the
+        # kernel's launch overhead loses to the fused scan (measured).
         from deeplearning4j_tpu.ops import pallas_kernels as pk
 
         if pk.pallas_enabled() and pk.lstm_scan_fits(n, n_out, t):

@@ -65,9 +65,10 @@ class TestLayerIntegration:
         conf = GravesLSTM(n_in=5, n_out=8, activation="tanh",
                           weight_init="xavier")
         impl = create_layer(conf)
-        params, state, _ = impl.initialize(jax.random.PRNGKey(0), (7, 5))
+        # t=8: the layer only engages the kernel for t >= 8 (recurrent.py)
+        params, state, _ = impl.initialize(jax.random.PRNGKey(0), (8, 5))
         x = jnp.asarray(
-            np.random.default_rng(0).normal(size=(3, 7, 5)).astype(np.float32)
+            np.random.default_rng(0).normal(size=(3, 8, 5)).astype(np.float32)
         )
         ys_scan, st_scan = impl.apply(params, state, x)
 
@@ -75,12 +76,15 @@ class TestLayerIntegration:
 
         monkeypatch.setattr(pk_mod, "pallas_enabled", lambda: True)
         real = pk_mod.lstm_pallas_scan
+        called = []
 
         def interp(xproj, u, p, h0, c0, interpret=False):
+            called.append(True)
             return real(xproj, u, p, h0, c0, True)
 
         monkeypatch.setattr(pk_mod, "lstm_pallas_scan", interp)
         ys_pal, st_pal = impl.apply(params, state, x)
+        assert called, "kernel path was not exercised (gate regression?)"
         np.testing.assert_allclose(ys_pal, ys_scan, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(st_pal["h"], st_scan["h"], rtol=1e-5,
                                    atol=1e-6)
