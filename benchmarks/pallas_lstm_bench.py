@@ -1,16 +1,17 @@
 #!/usr/bin/env python
 """Prove-or-drop benchmark: fused Pallas LSTM scan vs XLA lax.scan on the
-real chip (VERDICT round-1 item 9). Writes PALLAS_BENCH.json.
+real chip (VERDICT round-1 item 9).
 
-Round-1 recorded "XLA scan beats the hand kernel ~100x"; that measurement
-used jax.block_until_ready, which does NOT fence remote execution through
-the axon tunnel. Re-measured with a sound one-element readback fence, the
-verdict reversed: the kernel wins on every tested shape (see
-PALLAS_BENCH.json + case list below). The kernel
-is shape-gated and DEFAULT ON for TPU (DL4J_TPU_PALLAS=0 disables) — the
-selectable-backend slot mirroring the reference's reflective cuDNN helper
-loading (ConvolutionLayer.java:64-70). With a SOUND completion fence the
-round-1 '~100x slower' result reversed: the kernel wins on all shapes.
+Methodology: each (N, T, H) case times 60 jitted calls per implementation,
+fenced by a one-element host readback with a true data dependency
+(jax.block_until_ready does NOT fence remote execution through the axon
+tunnel — round-1's "scan wins ~100x" was that artifact), and asserts
+on-chip numerical equivalence between kernel and scan before recording.
+The measured verdict — written to PALLAS_BENCH.json, the single source of
+truth — drives whether the kernel stays default-on for TPU
+(ops/pallas_kernels.py pallas_enabled; DL4J_TPU_PALLAS=0 disables). This
+is the selectable-backend slot mirroring the reference's reflective cuDNN
+helper loading (ConvolutionLayer.java:64-70).
 """
 
 import json

@@ -423,6 +423,82 @@ class ComputationGraph:
         self._jit_cache[key] = fn
         return fn
 
+    def _get_fit_batches_fn(self, n_labels: int):
+        """K train steps fused into ONE lax.scan (see
+        MultiLayerNetwork._get_fit_batches_fn). Mask-free path: masked
+        multi-step training uses the per-step fit()."""
+        key = ("fit_batches", n_labels)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+
+        n_iters = max(1, self.conf.iterations)
+
+        def scan_fn(params, states, upd_state, inputs, labels, it0, rng):
+            def body(carry, inp):
+                params, states, upd_state, it = carry
+                xs_k, ys_k = inp
+
+                iter_losses = []
+                for _ in range(n_iters):  # conf.iterations, like fit()
+                    def loss_fn(p):
+                        return self._loss(
+                            p, states, xs_k, ys_k, train=True,
+                            rng=rng_mod.step_key(rng, it),
+                            masks=None, label_masks=None,
+                        )
+
+                    (loss, states), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
+                    updates, upd_state = self._update_all(
+                        grads, upd_state, params, it
+                    )
+                    params = apply_updates(params, updates, self.conf.minimize)
+                    it = it + 1
+                    iter_losses.append(loss)
+                return (params, states, upd_state, it), jnp.stack(iter_losses)
+
+            (params, states, upd_state, _), losses = jax.lax.scan(
+                body, (params, states, upd_state, it0), (inputs, labels)
+            )
+            return params, states, upd_state, losses.reshape(-1)
+
+        fn = jax.jit(scan_fn)
+        self._jit_cache[key] = fn
+        return fn
+
+    def fit_batches(self, features, labels):
+        """Fit each leading-axis slice ([K, N, ...]) inside a single
+        compiled scan — K MultiDataSet fits (each with ``conf.iterations``
+        optimizer iterations) without K host round-trips. Returns
+        per-iteration losses [K*iterations]. SGD, non-TBPTT, mask-free
+        path (same contract as MultiLayerNetwork.fit_batches)."""
+        if self.params is None:
+            self.init()
+        if self.conf.backprop_type == "truncated_bptt":
+            raise ValueError("fit_batches: use fit() for TBPTT training")
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            raise ValueError("fit_batches supports SGD-family training only")
+        inputs = self._as_inputs(features)  # validates the input count
+        labels_l = [jnp.asarray(l) for l in _as_list(labels)]
+        if len(labels_l) != len(self.conf.outputs):
+            raise ValueError(
+                f"expected {len(self.conf.outputs)} label arrays, got {len(labels_l)}"
+            )
+        fn = self._get_fit_batches_fn(len(labels_l))
+        self.params, self.states, self.updater_state, losses = fn(
+            self.params, self.states, self.updater_state,
+            inputs, labels_l,
+            jnp.asarray(self.iteration, jnp.int32), self._rng,
+        )
+        self._score_dev = losses[-1]
+        losses_np = np.asarray(losses)  # ONE bulk readback
+        for k in range(losses_np.shape[0]):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, float(losses_np[k]))
+            self.iteration += 1
+        return losses_np
+
     # ------------------------------------------------------------------- fit
     @property
     def score_value(self) -> float:

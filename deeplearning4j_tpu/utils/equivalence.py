@@ -46,17 +46,27 @@ def loss_curve(
     dev_ctx = jax.default_device(device) if device is not None else contextlib.nullcontext()
     with ctx, dev_ctx:
         net = net_builder()
-        losses: List[float] = []
+        losses = []
         for x, y in batches:
-            loss = net.fit(x, y)
-            losses.append(float(loss))
-    return np.asarray(losses, np.float64)
+            # keep losses device-resident: a float() per step is 100
+            # synchronous round-trips through the remote-TPU tunnel, which
+            # trips its rate limiting into minutes-long backoff sleeps
+            # (observed as a wedged north-star run); one bulk readback at
+            # the end has a data dependency on every step
+            losses.append(net.fit(x, y))
+        import jax.numpy as jnp
+
+        stacked = jnp.stack([jnp.asarray(l) for l in losses])
+        out = np.asarray(stacked, np.float64)  # ONE bulk transfer
+    return out
 
 
 def compare_backends(
     net_builder: Callable[[], object],
     batches: Sequence[Tuple[np.ndarray, np.ndarray]],
     steps: Optional[int] = None,
+    accel_matmul_precision: str = "float32",
+    precision_note: Optional[str] = None,
 ) -> Dict:
     """Run the 100-step (or `steps`-step) curve on the CPU backend and on the
     default backend in float32-strict mode; report both curves and their
@@ -71,7 +81,7 @@ def compare_backends(
     cpu = jax.local_devices(backend="cpu")[0]
     default_dev = jax.devices()[0]
 
-    def curve_with_retry(device, attempts=3):
+    def curve_with_retry(device, precision, attempts=3):
         # the remote-TPU tunnel can drop mid-run (UNAVAILABLE /
         # "transport ... Unexpected EOF"); the run is deterministic, so a
         # clean retry is sound
@@ -79,7 +89,8 @@ def compare_backends(
 
         for i in range(attempts):
             try:
-                return loss_curve(net_builder, batches, device=device)
+                return loss_curve(net_builder, batches, device=device,
+                                  matmul_precision=precision)
             except Exception as e:  # noqa: BLE001 — retry only transient infra errors
                 msg = str(e)
                 if ("UNAVAILABLE" not in msg and "transport" not in msg.lower()) \
@@ -87,14 +98,16 @@ def compare_backends(
                     raise
                 _time.sleep(5.0 * (i + 1))
 
-    curve_cpu = curve_with_retry(cpu)
-    curve_acc = curve_with_retry(default_dev)
+    curve_cpu = curve_with_retry(cpu, "float32")
+    curve_acc = curve_with_retry(default_dev, accel_matmul_precision)
     abs_dev = np.abs(curve_acc - curve_cpu)
     denom = np.maximum(np.abs(curve_cpu), 1e-12)
     return {
         "steps": len(batches),
         "backend_cpu": str(cpu.platform),
         "backend_accel": str(default_dev.platform),
+        "accel_matmul_precision": accel_matmul_precision or "default",
+        **({"precision_note": precision_note} if precision_note else {}),
         "same_backend": cpu.platform == default_dev.platform,
         "curve_cpu": curve_cpu.tolist(),
         "curve_accel": curve_acc.tolist(),
@@ -157,8 +170,26 @@ def run_north_star(
         )
         return net.init(input_shape=(1, 40))
 
+    import jax
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    # f32-strict (HIGHEST) CONV compiles hang/wedge the axon remote
+    # compile helper (reproduced: LeNet strict compile >9 min, never
+    # completes; the matmul-only char-RNN compiles strict in ~80s). On an
+    # accelerator the conv model therefore runs at default precision,
+    # loudly labeled; the CPU leg and the test environment stay strict.
+    lenet_prec = None if on_accel else "float32"
+    lenet_note = (
+        "accel leg at DEFAULT matmul precision: float32-strict conv "
+        "compilation hangs the remote TPU compile helper (infra "
+        "limitation); deviation therefore includes bf16-pass rounding"
+        if on_accel else None
+    )
     results = {
-        "lenet5": compare_backends(lenet_builder, mnist_batches(steps)),
+        "lenet5": compare_backends(
+            lenet_builder, mnist_batches(steps),
+            accel_matmul_precision=lenet_prec, precision_note=lenet_note,
+        ),
         "char_rnn": compare_backends(char_builder, char_batches(steps)),
     }
     if artifact_path:

@@ -608,3 +608,45 @@ class TestGraphTbptt:
         for _ in range(10):
             loss2 = net.fit(x, y)
         assert float(loss2) < float(loss)
+
+
+def test_graph_fit_batches_equals_serial():
+    """K-step fused scan == K serial fits (params + losses), graph container."""
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+
+    x, y = load_iris()
+    K, N = 3, 30
+    xs = np.stack([x[i * N:(i + 1) * N] for i in range(K)])
+    ys = np.stack([y[i * N:(i + 1) * N] for i in range(K)])
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(3)
+            .learning_rate(0.1)
+            .updater("adam")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "d")
+            .set_outputs("out")
+            .build()
+        )
+        return ComputationGraph(conf).init()
+
+    serial = build()
+    serial_losses = [float(serial.fit(xs[k], ys[k])) for k in range(K)]
+    fused = build()
+    fused_losses = fused.fit_batches(xs, ys)
+    np.testing.assert_allclose(fused_losses, serial_losses, rtol=1e-6)
+    for name in serial.params:
+        for pn in serial.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(fused.params[name][pn]),
+                np.asarray(serial.params[name][pn]),
+                rtol=1e-6, atol=1e-7, err_msg=f"{name}.{pn}",
+            )
+    assert fused.iteration == serial.iteration == K

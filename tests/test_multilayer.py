@@ -206,3 +206,118 @@ def test_rnn_clear_previous_state_keeps_params():
     net.rnn_clear_previous_state()
     assert np.asarray(net.states[0]["h"]).shape[0] == 0
     np.testing.assert_array_equal(w_before, np.asarray(net.params[0]["W"]))
+
+
+# ---------------------------------------------------------------------------
+# fit_batches: K steps fused in one lax.scan == K serial fit() calls
+# ---------------------------------------------------------------------------
+
+
+def _dropout_net(seed=11):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(0.05)
+        .updater("adam")
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=16, activation="relu", dropout=0.3))
+        .layer(
+            1,
+            OutputLayer(
+                n_in=16, n_out=3, activation="softmax", loss_function="mcxent"
+            ),
+        )
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def test_fit_batches_equals_serial_fits():
+    x, y = load_iris()
+    K, N = 4, 30
+    xs = np.stack([x[i * N:(i + 1) * N] for i in range(K)])
+    ys = np.stack([y[i * N:(i + 1) * N] for i in range(K)])
+
+    serial = iris_net(seed=5, updater="adam")
+    serial_losses = [float(serial.fit(xs[k], ys[k])) for k in range(K)]
+
+    fused = iris_net(seed=5, updater="adam")
+    fused_losses = fused.fit_batches(xs, ys)
+
+    np.testing.assert_allclose(fused_losses, serial_losses, rtol=1e-6)
+    for p_s, p_f in zip(serial.params, fused.params):
+        for name in p_s:
+            np.testing.assert_allclose(
+                np.asarray(p_f[name]), np.asarray(p_s[name]),
+                rtol=1e-6, atol=1e-7, err_msg=name,
+            )
+    assert fused.iteration == serial.iteration == K
+
+
+def test_fit_batches_matches_serial_with_dropout_rng():
+    """Per-step dropout streams must line up with the serial path."""
+    x, y = load_iris()
+    K, N = 3, 40
+    xs = np.stack([x[i * N:(i + 1) * N] for i in range(K)])
+    ys = np.stack([y[i * N:(i + 1) * N] for i in range(K)])
+
+    serial = _dropout_net()
+    serial_losses = [float(serial.fit(xs[k], ys[k])) for k in range(K)]
+    fused = _dropout_net()
+    fused_losses = fused.fit_batches(xs, ys)
+    np.testing.assert_allclose(fused_losses, serial_losses, rtol=1e-6)
+    for p_s, p_f in zip(serial.params, fused.params):
+        for name in p_s:
+            np.testing.assert_allclose(
+                np.asarray(p_f[name]), np.asarray(p_s[name]),
+                rtol=1e-6, atol=1e-7,
+            )
+
+
+def test_fit_batches_listeners_and_guards():
+    x, y = load_iris()
+    xs, ys = np.stack([x[:20], x[20:40]]), np.stack([y[:20], y[20:40]])
+    net = iris_net(seed=9)
+    lst = CollectScoresIterationListener()
+    net.listeners.append(lst)
+    losses = net.fit_batches(xs, ys)
+    assert len(losses) == 2 and len(lst.scores) == 2
+    assert lst.scores[0][1] == pytest.approx(losses[0], rel=1e-6)
+
+
+def test_fit_batches_respects_conf_iterations():
+    """conf.iterations > 1: fused path == serial fit()s (which run
+    `iterations` optimizer steps per batch)."""
+    x, y = load_iris()
+    K, N = 2, 30
+    xs = np.stack([x[i * N:(i + 1) * N] for i in range(K)])
+    ys = np.stack([y[i * N:(i + 1) * N] for i in range(K)])
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(21)
+            .learning_rate(0.05)
+            .updater("nesterovs")
+            .iterations(3)
+            .list()
+            .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    serial = build()
+    for k in range(K):
+        serial.fit(xs[k], ys[k])
+    fused = build()
+    losses = fused.fit_batches(xs, ys)
+    assert losses.shape == (K * 3,)
+    assert fused.iteration == serial.iteration == K * 3
+    for p_s, p_f in zip(serial.params, fused.params):
+        for name in p_s:
+            np.testing.assert_allclose(
+                np.asarray(p_f[name]), np.asarray(p_s[name]),
+                rtol=1e-6, atol=1e-7, err_msg=name,
+            )
