@@ -46,6 +46,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -469,9 +470,45 @@ def bench_north_star(steps=100, timeout=1800):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _probe_device(timeout_s: float = 180.0) -> Optional[str]:
+    """Liveness probe: run a tiny op with a hard deadline in a worker
+    thread. A dead remote-TPU tunnel HANGS (no error), which would wedge
+    the whole bench — better to report and exit."""
+    import threading
+
+    result: dict = {}
+
+    def work():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            result["ok"] = float(jnp.ones((2,)).sum())
+            result["device"] = str(jax.devices()[0])
+        except Exception as e:  # noqa: BLE001
+            result["err"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "ok" in result:
+        _log(f"device probe ok: {result.get('device')}")
+        return None
+    return result.get("err", f"device probe hung for {timeout_s:.0f}s "
+                             "(remote-TPU tunnel down?)")
+
+
 def main():
     quick = "--quick" in sys.argv
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--only=")]
+    probe_err = _probe_device()
+    if probe_err:
+        print(json.dumps({
+            "metric": "lenet5_mnist_train_throughput", "value": 0.0,
+            "unit": "samples/sec/chip", "vs_baseline": 0.0,
+            "error": f"accelerator unavailable: {probe_err}",
+        }))
+        return
     _enable_compile_cache()
     extras = {}
 

@@ -17,6 +17,24 @@ def tbptt_backprop_window(conf) -> Optional[int]:
     return None
 
 
+def remat_apply(layer, params, state, x, rng, mask, kwargs,
+                prevent_cse: bool = True):
+    """Apply a layer under jax.checkpoint: store only the layer INPUT and
+    recompute its activations in the backward pass (dropout rng keys are
+    counter-based, so recomputed masks are identical). prevent_cse=False
+    is for callers whose remat sits inside a lax.scan body (fit_batches) —
+    the loop boundary already blocks the CSE the barrier guards against,
+    so the default barriers would only cost fusion opportunities."""
+    import jax
+
+    def _apply(p, s, xx, lr):
+        return layer.apply(p, s, xx, train=True, rng=lr, mask=mask, **kwargs)
+
+    return jax.checkpoint(_apply, prevent_cse=prevent_cse)(
+        params, state, x, rng
+    )
+
+
 def decay_lr_scale_entry(state, rate: float):
     """One updater-state entry with its 'lr_scale' (the cumulative 'score'
     LR-policy decay, reference Model.applyLearningRateScoreDecay) multiplied

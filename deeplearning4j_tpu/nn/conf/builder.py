@@ -238,6 +238,7 @@ class ListBuilder:
         self._backprop = True
         self._pretrain = False
         self._backprop_type = "standard"
+        self._gradient_checkpointing = False
         self._tbptt_fwd_length = 20
         self._tbptt_back_length = 20
 
@@ -272,6 +273,13 @@ class ListBuilder:
         self._tbptt_fwd_length = int(n)
         return self
 
+    def gradient_checkpointing(self, enabled: bool = True) -> "ListBuilder":
+        """Rematerialize layer activations in backward (jax.checkpoint):
+        less HBM, more FLOPs. TPU-first addition (no 2016 reference
+        equivalent)."""
+        self._gradient_checkpointing = bool(enabled)
+        return self
+
     def t_bptt_backward_length(self, n: int) -> "ListBuilder":
         self._tbptt_back_length = int(n)
         return self
@@ -295,6 +303,7 @@ class ListBuilder:
             backprop=self._backprop,
             pretrain=self._pretrain,
             backprop_type=self._backprop_type,
+            gradient_checkpointing=self._gradient_checkpointing,
             tbptt_fwd_length=self._tbptt_fwd_length,
             tbptt_back_length=self._tbptt_back_length,
             **self._parent.training_conf(),

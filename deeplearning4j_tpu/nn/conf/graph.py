@@ -160,6 +160,7 @@ class ComputationGraphConfiguration:
     pretrain: bool = False
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
+    gradient_checkpointing: bool = False  # remat layer activations (jax.checkpoint)
     tbptt_back_length: int = 20
     seed: int = 123
     iterations: int = 1
@@ -245,6 +246,7 @@ class ComputationGraphConfiguration:
             "pretrain": self.pretrain,
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
+            "gradient_checkpointing": self.gradient_checkpointing,
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
             "iterations": self.iterations,
@@ -295,6 +297,7 @@ class ComputationGraphConfiguration:
             pretrain=d.get("pretrain", False),
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            gradient_checkpointing=d.get("gradient_checkpointing", False),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             seed=d.get("seed", 123),
             iterations=d.get("iterations", 1),
@@ -367,6 +370,7 @@ class GraphBuilder:
         self._pretrain = False
         self._backprop_type = "standard"
         self._tbptt_fwd_length = 20
+        self._gradient_checkpointing = False
         self._tbptt_back_length = 20
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
@@ -414,6 +418,11 @@ class GraphBuilder:
         self._tbptt_fwd_length = int(n)
         return self
 
+    def gradient_checkpointing(self, enabled: bool = True) -> "GraphBuilder":
+        """Rematerialize layer activations in backward (jax.checkpoint)."""
+        self._gradient_checkpointing = bool(enabled)
+        return self
+
     def t_bptt_backward_length(self, n: int) -> "GraphBuilder":
         self._tbptt_back_length = int(n)
         return self
@@ -434,6 +443,7 @@ class GraphBuilder:
             pretrain=self._pretrain,
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd_length,
+            gradient_checkpointing=self._gradient_checkpointing,
             tbptt_back_length=self._tbptt_back_length,
             **self._parent.training_conf(),
         )

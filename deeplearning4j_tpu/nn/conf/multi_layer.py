@@ -25,6 +25,11 @@ class MultiLayerConfiguration:
     backprop_type: str = "standard"  # standard | truncated_bptt
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
+    # remat: recompute per-layer activations in the backward pass instead of
+    # storing them (jax.checkpoint) — trades FLOPs for HBM, enabling bigger
+    # batches/deeper nets on TPU. No reference equivalent (2016 JVM had no
+    # activation rematerialization); TPU-first addition.
+    gradient_checkpointing: bool = False
     # training hyperparams (from the Builder)
     seed: int = 123
     iterations: int = 1
@@ -54,6 +59,7 @@ class MultiLayerConfiguration:
             "backprop": self.backprop,
             "pretrain": self.pretrain,
             "backprop_type": self.backprop_type,
+            "gradient_checkpointing": self.gradient_checkpointing,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
@@ -94,6 +100,7 @@ class MultiLayerConfiguration:
             backprop=d.get("backprop", True),
             pretrain=d.get("pretrain", False),
             backprop_type=d.get("backprop_type", "standard"),
+            gradient_checkpointing=d.get("gradient_checkpointing", False),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             seed=d.get("seed", 123),

@@ -239,6 +239,7 @@ class ComputationGraph:
         masks: Optional[Dict[str, jax.Array]] = None,
         carry_state: bool = False,
         backprop_window: Optional[int] = None,
+        remat_prevent_cse: bool = True,
     ):
         """Forward all vertices in topo order. Returns (activations dict
         name->array incl. inputs, new states dict).
@@ -271,15 +272,22 @@ class ComputationGraph:
                     v, STATEFUL_RNN_CONFS
                 ):
                     kwargs["backprop_window"] = backprop_window
-                y, ns = layer.apply(
-                    params[name],
-                    states[name],
-                    x,
-                    train=train,
-                    rng=lrng,
-                    mask=lmask,
-                    **kwargs,
-                )
+                if train and self.conf.gradient_checkpointing:
+                    from deeplearning4j_tpu.nn.common import remat_apply
+
+                    y, ns = remat_apply(layer, params[name], states[name],
+                                        x, lrng, lmask, kwargs,
+                                        prevent_cse=remat_prevent_cse)
+                else:
+                    y, ns = layer.apply(
+                        params[name],
+                        states[name],
+                        x,
+                        train=train,
+                        rng=lrng,
+                        mask=lmask,
+                        **kwargs,
+                    )
                 new_states[name] = ns
                 if in_mask is not None:
                     masks[name] = in_mask
@@ -321,6 +329,7 @@ class ComputationGraph:
         label_masks: Optional[List] = None,
         carry_state: bool = False,
         backprop_window: Optional[int] = None,
+        remat_prevent_cse: bool = True,
     ):
         """Sum of output-layer losses (reference computeGradientAndScore
         :894-907 sums per-output scores) + regularization."""
@@ -335,6 +344,7 @@ class ComputationGraph:
             train=train,
             rng=rng,
             masks=masks,
+            remat_prevent_cse=remat_prevent_cse,
             carry_state=carry_state,
             backprop_window=backprop_window,
         )
@@ -445,6 +455,7 @@ class ComputationGraph:
                             p, states, xs_k, ys_k, train=True,
                             rng=rng_mod.step_key(rng, it),
                             masks=None, label_masks=None,
+                            remat_prevent_cse=False,  # scan boundary blocks CSE
                         )
 
                     (loss, states), grads = jax.value_and_grad(

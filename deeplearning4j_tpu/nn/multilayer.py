@@ -138,6 +138,7 @@ class MultiLayerNetwork:
         upto: Optional[int] = None,
         carry_state: bool = False,
         backprop_window: Optional[int] = None,
+        remat_prevent_cse: bool = True,
     ):
         """Forward through layers [0, upto). Returns (activations list incl.
         input, new_states). Mask is passed to recurrent-family layers only.
@@ -163,9 +164,17 @@ class MultiLayerNetwork:
                 self.conf.layers[i], STATEFUL_RNN_CONFS
             ):
                 kwargs["backprop_window"] = backprop_window
-            y, ns = layer.apply(
-                params[i], states[i], x, train=train, rng=lrng, mask=lmask, **kwargs
-            )
+            if train and self.conf.gradient_checkpointing:
+                from deeplearning4j_tpu.nn.common import remat_apply
+
+                y, ns = remat_apply(layer, params[i], states[i], x, lrng,
+                                    lmask, kwargs,
+                                    prevent_cse=remat_prevent_cse)
+            else:
+                y, ns = layer.apply(
+                    params[i], states[i], x, train=train, rng=lrng,
+                    mask=lmask, **kwargs
+                )
             new_states[i] = ns
             acts.append(y)
             x = y
@@ -207,6 +216,7 @@ class MultiLayerNetwork:
         label_mask=None,
         carry_state: bool = False,
         backprop_window: Optional[int] = None,
+        remat_prevent_cse: bool = True,
     ):
         out_impl = self.layers[-1]
         if not isinstance(out_impl, OutputLayerImpl):
@@ -221,6 +231,7 @@ class MultiLayerNetwork:
             upto=len(self.layers) - 1,
             carry_state=carry_state,
             backprop_window=backprop_window,
+            remat_prevent_cse=remat_prevent_cse,
         )
         last_in = self._apply_preprocessor(
             len(self.layers) - 1, acts[-1], x.shape[0]
@@ -383,6 +394,9 @@ class MultiLayerNetwork:
                             p, states, x, y, train=True,
                             rng=rng_mod.step_key(rng, it),
                             mask=mask, label_mask=lmask,
+                            # inside lax.scan the loop boundary already
+                            # prevents CSE; skip the remat barriers
+                            remat_prevent_cse=False,
                         )
 
                     (loss, states), grads = jax.value_and_grad(

@@ -90,6 +90,31 @@ class ParallelWrapper:
             net._record_iteration(loss)
         return loss
 
+    def fit_batches(self, features, labels):
+        """Data-parallel fused multi-step training: K stacked batches
+        [K, N, ...] run through the container's fit_batches scan with the
+        example axis sharded over the mesh — one XLA program containing
+        the whole K-step loop AND the per-step gradient psum (GSPMD). The
+        equivalent of the reference ParallelWrapper iterating fit() over a
+        DataSetIterator, minus every host round-trip."""
+        self._place_model()
+        net = self.net
+
+        def shard_stacked(a):
+            a = jnp.asarray(a)
+            self._check_divisible(a.shape[1])
+            spec = P(*((None, DATA_AXIS) + (None,) * (a.ndim - 2)))
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        if hasattr(net, "_as_inputs"):  # ComputationGraph
+            feats = features if isinstance(features, (list, tuple)) else [features]
+            labs = labels if isinstance(labels, (list, tuple)) else [labels]
+            return net.fit_batches(
+                [shard_stacked(f) for f in feats],
+                [shard_stacked(l) for l in labs],
+            )
+        return net.fit_batches(shard_stacked(features), shard_stacked(labels))
+
     def _check_divisible(self, b: int) -> None:
         if b % self.n != 0:
             raise ValueError(

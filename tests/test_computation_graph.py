@@ -650,3 +650,43 @@ def test_graph_fit_batches_equals_serial():
                 rtol=1e-6, atol=1e-7, err_msg=f"{name}.{pn}",
             )
     assert fused.iteration == serial.iteration == K
+
+
+def test_graph_gradient_checkpointing_matches_plain():
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+
+    x, y = load_iris()
+
+    def build(ckpt):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(17).learning_rate(0.05).updater("adam")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=12, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=12, n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "d")
+            .set_outputs("out")
+            .gradient_checkpointing(ckpt)
+            .build()
+        )
+        assert conf.gradient_checkpointing is ckpt
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return ComputationGraph(conf).init()
+
+    plain, ckpt = build(False), build(True)
+    for _ in range(3):
+        assert float(plain.fit(x, y)) == pytest.approx(float(ckpt.fit(x, y)), rel=1e-6)
+    for name in plain.params:
+        for pn in plain.params[name]:
+            np.testing.assert_allclose(
+                np.asarray(ckpt.params[name][pn]),
+                np.asarray(plain.params[name][pn]), rtol=1e-6, atol=1e-7)
+    # serde keeps the flag
+    from deeplearning4j_tpu.nn.conf.graph import ComputationGraphConfiguration
+
+    rt = ComputationGraphConfiguration.from_dict(build(True).conf.to_dict())
+    assert rt.gradient_checkpointing is True

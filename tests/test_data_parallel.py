@@ -201,3 +201,27 @@ def test_param_averaging_masked_sequences():
     w_m = np.asarray(net_m.params[0]["W"])
     w_u = np.asarray(net_u.params[0]["W"])
     assert not np.allclose(w_m, w_u)
+
+
+def test_parallel_fit_batches_equals_serial():
+    """Fused K-step DP scan == serial single-device fit_batches (GSPMD DP
+    is numerically big-batch training)."""
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+
+    x, y = load_iris()
+    K, N = 2, 48
+    xs = np.stack([x[i * N:(i + 1) * N] for i in range(K)])
+    ys = np.stack([y[i * N:(i + 1) * N] for i in range(K)])
+
+    serial = iris_net(seed=31)
+    serial_losses = serial.fit_batches(xs, ys)
+    dp_net = iris_net(seed=31)
+    pw = ParallelWrapper(dp_net, num_devices=8)
+    dp_losses = pw.fit_batches(xs, ys)
+    np.testing.assert_allclose(dp_losses, serial_losses, rtol=1e-5)
+    for p_s, p_f in zip(serial.params, dp_net.params):
+        for name in p_s:
+            np.testing.assert_allclose(
+                np.asarray(p_f[name]), np.asarray(p_s[name]),
+                rtol=1e-5, atol=1e-6, err_msg=name,
+            )

@@ -321,3 +321,54 @@ def test_fit_batches_respects_conf_iterations():
                 np.asarray(p_f[name]), np.asarray(p_s[name]),
                 rtol=1e-6, atol=1e-7, err_msg=name,
             )
+
+
+def test_gradient_checkpointing_matches_plain():
+    """remat changes memory use, never values: losses + params after
+    training must match the non-checkpointed run exactly."""
+    x, y = load_iris()
+
+    def build(ckpt):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(13)
+            .learning_rate(0.05)
+            .updater("adam")
+            .list()
+            .gradient_checkpointing(ckpt)
+            .layer(0, DenseLayer(n_in=4, n_out=16, activation="tanh",
+                                 dropout=0.2))
+            .layer(1, DenseLayer(n_in=16, n_out=8, activation="relu"))
+            .layer(2, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build()
+        )
+        assert conf.gradient_checkpointing is ckpt
+        return MultiLayerNetwork(conf).init()
+
+    plain, ckpt = build(False), build(True)
+    for _ in range(4):
+        lp = float(plain.fit(x, y))
+        lc = float(ckpt.fit(x, y))
+        assert lp == pytest.approx(lc, rel=1e-6)
+    for p_s, p_f in zip(plain.params, ckpt.params):
+        for name in p_s:
+            np.testing.assert_allclose(
+                np.asarray(p_f[name]), np.asarray(p_s[name]),
+                rtol=1e-6, atol=1e-7,
+            )
+
+
+def test_gradient_checkpointing_serde_round_trip():
+    from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(1).list()
+        .layer(0, DenseLayer(n_in=4, n_out=4))
+        .layer(1, OutputLayer(n_in=4, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .gradient_checkpointing(True)
+        .build()
+    )
+    rt = MultiLayerConfiguration.from_dict(conf.to_dict())
+    assert rt.gradient_checkpointing is True
