@@ -259,14 +259,16 @@ def _peak_flops_per_chip() -> float:
     return 197e12  # conservative default
 
 
-def bench_resnet50(batch=128, steps=10, input_size=224):
+def bench_resnet50(batch=128, steps=10, input_size=224,
+                   dtype_policy="strict"):
     import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.resnet import build_resnet50
 
     net = build_resnet50(input_size=input_size, num_classes=1000,
-                         updater="nesterovs", learning_rate=0.05)
+                         updater="nesterovs", learning_rate=0.05,
+                         dtype_policy=dtype_policy)
     rng = np.random.default_rng(0)
     x = jax.device_put(
         rng.random((batch, input_size, input_size, 3)).astype(np.float32)
@@ -308,6 +310,7 @@ def bench_resnet50(batch=128, steps=10, input_size=224):
         "step_flops": flops,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "batch": batch, "input": input_size,
+        "dtype_policy": dtype_policy,
     }
 
 
@@ -530,6 +533,8 @@ def main():
         steps=3 if quick else 8)
     run("char_rnn", bench_char_rnn, steps=3 if quick else 10)
     run("resnet50", bench_resnet50, steps=3 if quick else 10)
+    run("resnet50_bf16", bench_resnet50, steps=3 if quick else 10,
+        dtype_policy="performance")
     run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
     run("scaling_virtual8", bench_scaling)
     run("north_star", bench_north_star, steps=10 if quick else 100)

@@ -75,6 +75,8 @@ def resnet50_conf(
     updater: str = "nesterovs",
     momentum: float = 0.9,
     l2: float = 1e-4,
+    dtype_policy: str = "strict",
+    gradient_checkpointing: bool = False,
 ):
     gb = (
         NeuralNetConfiguration.builder()
@@ -86,6 +88,8 @@ def resnet50_conf(
         .weight_init("relu")  # He init, reference WeightInit.RELU
         .graph_builder()
         .add_inputs("in")
+        .dtype_policy(dtype_policy)
+        .gradient_checkpointing(gradient_checkpointing)
     )
     stem = _conv_bn(gb, "stem", in_channels, 64, (7, 7), (2, 2), (3, 3), "in",
                     activation="relu")

@@ -17,6 +17,59 @@ def tbptt_backprop_window(conf) -> Optional[int]:
     return None
 
 
+def compute_dtype_of(conf):
+    """jnp dtype for the conf's dtype_policy, or None for strict f32.
+    'performance' = bfloat16 compute with float32 master params — the MXU's
+    native mode (SURVEY §7: 'bf16 MXU matmuls'). The reference is
+    f32-everywhere (2016 ND4J); this is the TPU-first performance mode."""
+    import jax.numpy as jnp
+
+    if getattr(conf, "dtype_policy", "strict") == "performance":
+        return jnp.bfloat16
+    return None
+
+
+def cast_for_compute(params, x, dtype):
+    """Cast the layer input and the layer's float32 param leaves to the
+    compute dtype. ONLY f32 is downcast — integer inputs (embedding row
+    indices) and f64 (gradient-check mode) pass through untouched. Master
+    params stay f32 outside the step — autodiff through the cast yields
+    f32 grads on the masters (standard mixed precision)."""
+    import jax
+    import jax.numpy as jnp
+
+    cast = lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a
+    return jax.tree_util.tree_map(cast, params), cast(x)
+
+
+def apply_layer(layer, conf, params, state, x, rng, mask, kwargs, *,
+                train: bool, remat_prevent_cse: bool = True):
+    """The shared per-layer application policy for both containers:
+    mixed-precision casting (conf.dtype_policy) + remat-vs-plain dispatch
+    (conf.gradient_checkpointing). Output layers are never downcast
+    (softmax+loss numerics stay f32)."""
+    from deeplearning4j_tpu.nn.layers.feedforward import OutputLayerImpl
+
+    compute_dtype = compute_dtype_of(conf)
+    if compute_dtype is not None and not isinstance(layer, OutputLayerImpl):
+        params, x = cast_for_compute(params, x, compute_dtype)
+    if train and conf.gradient_checkpointing:
+        return remat_apply(layer, params, state, x, rng, mask, kwargs,
+                           prevent_cse=remat_prevent_cse)
+    return layer.apply(params, state, x, train=train, rng=rng, mask=mask,
+                       **kwargs)
+
+
+def cast_loss_input(x):
+    """Loss math stays >= f32: upcast low-precision activations, leave
+    f32/f64 untouched (f64 = gradient-check mode)."""
+    import jax.numpy as jnp
+
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.asarray(x, jnp.float32)
+    return x
+
+
 def remat_apply(layer, params, state, x, rng, mask, kwargs,
                 prevent_cse: bool = True):
     """Apply a layer under jax.checkpoint: store only the layer INPUT and

@@ -239,6 +239,7 @@ class ListBuilder:
         self._pretrain = False
         self._backprop_type = "standard"
         self._gradient_checkpointing = False
+        self._dtype_policy = "strict"
         self._tbptt_fwd_length = 20
         self._tbptt_back_length = 20
 
@@ -280,6 +281,14 @@ class ListBuilder:
         self._gradient_checkpointing = bool(enabled)
         return self
 
+    def dtype_policy(self, policy: str) -> "ListBuilder":
+        """'strict' (f32, reference semantics) or 'performance' (bf16
+        compute with f32 master params — the MXU-native mixed precision)."""
+        if policy not in ("strict", "performance"):
+            raise ValueError(f"unknown dtype_policy {policy!r}")
+        self._dtype_policy = policy
+        return self
+
     def t_bptt_backward_length(self, n: int) -> "ListBuilder":
         self._tbptt_back_length = int(n)
         return self
@@ -304,6 +313,7 @@ class ListBuilder:
             pretrain=self._pretrain,
             backprop_type=self._backprop_type,
             gradient_checkpointing=self._gradient_checkpointing,
+            dtype_policy=self._dtype_policy,
             tbptt_fwd_length=self._tbptt_fwd_length,
             tbptt_back_length=self._tbptt_back_length,
             **self._parent.training_conf(),

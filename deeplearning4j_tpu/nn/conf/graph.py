@@ -161,6 +161,7 @@ class ComputationGraphConfiguration:
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     gradient_checkpointing: bool = False  # remat layer activations (jax.checkpoint)
+    dtype_policy: str = "strict"  # 'performance' = bf16 compute / f32 masters
     tbptt_back_length: int = 20
     seed: int = 123
     iterations: int = 1
@@ -247,6 +248,7 @@ class ComputationGraphConfiguration:
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "gradient_checkpointing": self.gradient_checkpointing,
+            "dtype_policy": self.dtype_policy,
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
             "iterations": self.iterations,
@@ -298,6 +300,7 @@ class ComputationGraphConfiguration:
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             gradient_checkpointing=d.get("gradient_checkpointing", False),
+            dtype_policy=d.get("dtype_policy", "strict"),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             seed=d.get("seed", 123),
             iterations=d.get("iterations", 1),
@@ -371,6 +374,7 @@ class GraphBuilder:
         self._backprop_type = "standard"
         self._tbptt_fwd_length = 20
         self._gradient_checkpointing = False
+        self._dtype_policy = "strict"
         self._tbptt_back_length = 20
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
@@ -423,6 +427,13 @@ class GraphBuilder:
         self._gradient_checkpointing = bool(enabled)
         return self
 
+    def dtype_policy(self, policy: str) -> "GraphBuilder":
+        """'strict' or 'performance' (bf16 compute / f32 masters)."""
+        if policy not in ("strict", "performance"):
+            raise ValueError(f"unknown dtype_policy {policy!r}")
+        self._dtype_policy = policy
+        return self
+
     def t_bptt_backward_length(self, n: int) -> "GraphBuilder":
         self._tbptt_back_length = int(n)
         return self
@@ -444,6 +455,7 @@ class GraphBuilder:
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd_length,
             gradient_checkpointing=self._gradient_checkpointing,
+            dtype_policy=self._dtype_policy,
             tbptt_back_length=self._tbptt_back_length,
             **self._parent.training_conf(),
         )

@@ -30,6 +30,9 @@ class MultiLayerConfiguration:
     # batches/deeper nets on TPU. No reference equivalent (2016 JVM had no
     # activation rematerialization); TPU-first addition.
     gradient_checkpointing: bool = False
+    # 'strict' = f32 everywhere (reference ND4J semantics, the north-star
+    # mode); 'performance' = bf16 compute / f32 masters (MXU-native)
+    dtype_policy: str = "strict"
     # training hyperparams (from the Builder)
     seed: int = 123
     iterations: int = 1
@@ -60,6 +63,7 @@ class MultiLayerConfiguration:
             "pretrain": self.pretrain,
             "backprop_type": self.backprop_type,
             "gradient_checkpointing": self.gradient_checkpointing,
+            "dtype_policy": self.dtype_policy,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
             "seed": self.seed,
@@ -101,6 +105,7 @@ class MultiLayerConfiguration:
             pretrain=d.get("pretrain", False),
             backprop_type=d.get("backprop_type", "standard"),
             gradient_checkpointing=d.get("gradient_checkpointing", False),
+            dtype_policy=d.get("dtype_policy", "strict"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
             seed=d.get("seed", 123),

@@ -247,6 +247,8 @@ class ComputationGraph:
         Mask propagation: a vertex inherits the mask of its first masked
         input; LastTimeStep drops it (time axis removed) — the simplified
         equivalent of the reference's setLayerMaskArrays flow."""
+        from deeplearning4j_tpu.nn.common import apply_layer
+
         masks = dict(masks or {})
         acts: Dict[str, jax.Array] = dict(inputs)
         new_states = dict(states)
@@ -272,22 +274,11 @@ class ComputationGraph:
                     v, STATEFUL_RNN_CONFS
                 ):
                     kwargs["backprop_window"] = backprop_window
-                if train and self.conf.gradient_checkpointing:
-                    from deeplearning4j_tpu.nn.common import remat_apply
-
-                    y, ns = remat_apply(layer, params[name], states[name],
-                                        x, lrng, lmask, kwargs,
-                                        prevent_cse=remat_prevent_cse)
-                else:
-                    y, ns = layer.apply(
-                        params[name],
-                        states[name],
-                        x,
-                        train=train,
-                        rng=lrng,
-                        mask=lmask,
-                        **kwargs,
-                    )
+                y, ns = apply_layer(
+                    layer, self.conf, params[name], states[name], x, lrng,
+                    lmask, kwargs, train=train,
+                    remat_prevent_cse=remat_prevent_cse,
+                )
                 new_states[name] = ns
                 if in_mask is not None:
                     masks[name] = in_mask
@@ -350,6 +341,8 @@ class ComputationGraph:
         )
         # mask propagated to each output vertex's input (label-mask fallback,
         # mirroring MLN: lmask = label_mask if set else feature mask)
+        from deeplearning4j_tpu.nn.common import cast_loss_input
+
         prop_masks = dict(masks or {})
         for name in self.topo:
             ins = self.conf.vertex_inputs[name]
@@ -380,6 +373,7 @@ class ComputationGraph:
             lm = label_masks[oi] if label_masks else None
             if lm is None:
                 lm = prop_masks.get(in_name)
+            x = cast_loss_input(x)
             total = total + impl.loss(params[oname], x, labels[oi], lm)
         return total + self._regularization_penalty(params), new_states
 

@@ -146,6 +146,8 @@ class MultiLayerNetwork:
         (TBPTT window chaining). backprop_window truncates each recurrent
         layer's in-window backward pass (distinct tbptt_back_length,
         reference LSTMHelpers.backpropGradientHelper:255)."""
+        from deeplearning4j_tpu.nn.common import apply_layer
+
         n_layers = len(self.layers) if upto is None else upto
         batch_n = x.shape[0]
         acts = [x]
@@ -164,17 +166,10 @@ class MultiLayerNetwork:
                 self.conf.layers[i], STATEFUL_RNN_CONFS
             ):
                 kwargs["backprop_window"] = backprop_window
-            if train and self.conf.gradient_checkpointing:
-                from deeplearning4j_tpu.nn.common import remat_apply
-
-                y, ns = remat_apply(layer, params[i], states[i], x, lrng,
-                                    lmask, kwargs,
-                                    prevent_cse=remat_prevent_cse)
-            else:
-                y, ns = layer.apply(
-                    params[i], states[i], x, train=train, rng=lrng,
-                    mask=lmask, **kwargs
-                )
+            y, ns = apply_layer(
+                layer, self.conf, params[i], states[i], x, lrng, lmask,
+                kwargs, train=train, remat_prevent_cse=remat_prevent_cse,
+            )
             new_states[i] = ns
             acts.append(y)
             x = y
@@ -236,6 +231,9 @@ class MultiLayerNetwork:
         last_in = self._apply_preprocessor(
             len(self.layers) - 1, acts[-1], x.shape[0]
         )
+        from deeplearning4j_tpu.nn.common import cast_loss_input
+
+        last_in = cast_loss_input(last_in)
         if train and (self.conf.layers[-1].dropout or 0.0) > 0 and rng is not None:
             last_in = out_impl._dropout_in(
                 last_in, train, rng_mod.layer_key(rng, len(self.layers) - 1, "dropout")

@@ -690,3 +690,35 @@ def test_graph_gradient_checkpointing_matches_plain():
 
     rt = ComputationGraphConfiguration.from_dict(build(True).conf.to_dict())
     assert rt.gradient_checkpointing is True
+
+
+def test_graph_performance_dtype_policy_trains():
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.fetchers import load_iris
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    x, y = load_iris()
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(19).learning_rate(0.1).updater("adam")
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_in=4, n_out=12, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_in=12, n_out=3, activation="softmax",
+                                      loss_function="mcxent"), "d")
+        .set_outputs("out")
+        .dtype_policy("performance")
+        .build()
+    )
+    assert conf.dtype_policy == "performance"
+    net = ComputationGraph(conf).init()
+    first = float(net.fit(x, y))
+    for _ in range(40):
+        loss = float(net.fit(x, y))
+    assert loss < first * 0.7
+    import jax.numpy as jnp
+
+    for lp in net.params.values():
+        for a in lp.values():
+            assert a.dtype == jnp.float32

@@ -372,3 +372,90 @@ def test_gradient_checkpointing_serde_round_trip():
     )
     rt = MultiLayerConfiguration.from_dict(conf.to_dict())
     assert rt.gradient_checkpointing is True
+
+
+def test_performance_dtype_policy_trains():
+    """Mixed precision (bf16 compute / f32 masters): training converges,
+    master params stay f32, conf round-trips."""
+    from deeplearning4j_tpu.nn.conf.multi_layer import MultiLayerConfiguration
+
+    x, y = load_iris()
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(23).learning_rate(0.1).updater("adam")
+        .list()
+        .dtype_policy("performance")
+        .layer(0, DenseLayer(n_in=4, n_out=16, activation="tanh"))
+        .layer(1, OutputLayer(n_in=16, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    assert conf.dtype_policy == "performance"
+    assert MultiLayerConfiguration.from_dict(conf.to_dict()).dtype_policy == "performance"
+    net = MultiLayerNetwork(conf).init()
+    first = float(net.fit(x, y))
+    for _ in range(40):
+        loss = float(net.fit(x, y))
+    assert loss < first * 0.7, (first, loss)
+    # master params remain f32
+    import jax.numpy as jnp
+
+    for p in net.params:
+        for a in p.values():
+            assert a.dtype == jnp.float32, a.dtype
+    # accuracy sanity on the training set
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+    ev = Evaluation(3)
+    ev.eval(np.asarray(y), np.asarray(net.output(x)))
+    assert ev.accuracy() > 0.8
+
+
+def test_performance_policy_close_to_strict():
+    """bf16 compute tracks the strict-f32 loss curve within bf16 tolerance."""
+    x, y = load_iris()
+
+    def build(policy):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(29).learning_rate(0.05).updater("sgd")
+            .list()
+            .dtype_policy(policy)
+            .layer(0, DenseLayer(n_in=4, n_out=12, activation="relu"))
+            .layer(1, OutputLayer(n_in=12, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    strict, perf = build("strict"), build("performance")
+    for _ in range(10):
+        ls = float(strict.fit(x, y))
+        lp = float(perf.fit(x, y))
+    assert abs(ls - lp) / max(ls, 1e-6) < 0.05, (ls, lp)
+
+
+def test_performance_policy_preserves_embedding_indices():
+    """Integer embedding indices must NOT be bf16-cast (bf16 only
+    represents integers exactly up to 256)."""
+    from deeplearning4j_tpu.nn.conf.layers import EmbeddingLayer
+
+    vocab = 2000
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(3).learning_rate(0.05).updater("sgd")
+        .list()
+        .dtype_policy("performance")
+        .layer(0, EmbeddingLayer(n_in=vocab, n_out=8))
+        .layer(1, OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    idx = np.array([[1001], [1999], [5]], np.int32)
+    out = np.asarray(net.output(idx))
+    # distinct high indices must hit distinct embedding rows: outputs differ
+    assert not np.allclose(out[0], out[1]), "indices collapsed (bf16 cast?)"
+    y = np.eye(2, dtype=np.float32)[[0, 1, 0]]
+    loss = float(net.fit(idx, y))
+    assert np.isfinite(loss)
