@@ -1,0 +1,84 @@
+"""VGG-16 (Simonyan & Zisserman 2014) through the config DSL.
+
+Companion deep-CNN flagship to AlexNet/ResNet-50: thirteen 3x3 conv
+layers + three dense layers as one MultiLayerNetwork conf — exercises
+long sequential conv stacks, where gradient_checkpointing matters most
+(activations dominate HBM). Built on the same layer zoo as the reference
+(nn/conf/layers/*.java); no model zoo existed in the 2016 snapshot.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+INPUT_SHAPE = (224, 224, 3)
+
+# (out_channels, convs_in_block) per VGG-16 block
+_BLOCKS = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+
+def vgg16_conf(
+    num_classes: int = 1000,
+    in_channels: int = 3,
+    input_size: int = 224,
+    seed: int = 42,
+    learning_rate: float = 0.01,
+    updater: str = "nesterovs",
+    momentum: float = 0.9,
+    l2: float = 5e-4,
+    dropout: float = 0.5,
+    dtype_policy: str = "strict",
+    gradient_checkpointing: bool = False,
+):
+    lb = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .momentum(momentum)
+        .l2(l2)
+        .weight_init("relu")
+        .list()
+        .dtype_policy(dtype_policy)
+        .gradient_checkpointing(gradient_checkpointing)
+    )
+    idx = 0
+    c_in = in_channels
+    size = input_size
+    for c_out, reps in _BLOCKS:
+        for _ in range(reps):
+            lb.layer(idx, ConvolutionLayer(n_in=c_in, n_out=c_out,
+                                           kernel_size=(3, 3),
+                                           padding=(1, 1),
+                                           activation="relu"))
+            c_in = c_out
+            idx += 1
+        lb.layer(idx, SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        size //= 2
+        idx += 1
+    lb.layer(idx, DenseLayer(n_in=size * size * 512, n_out=4096,
+                             activation="relu", dropout=dropout))
+    lb.input_preprocessor(idx, CnnToFeedForwardPreProcessor(size, size, 512))
+    idx += 1
+    lb.layer(idx, DenseLayer(n_in=4096, n_out=4096, activation="relu",
+                             dropout=dropout))
+    idx += 1
+    lb.layer(idx, OutputLayer(n_in=4096, n_out=num_classes,
+                              activation="softmax", loss_function="mcxent"))
+    return lb.build()
+
+
+def build_vgg16(input_size: int = 224, num_classes: int = 1000,
+                **kw) -> MultiLayerNetwork:
+    conf = vgg16_conf(num_classes=num_classes, input_size=input_size, **kw)
+    return MultiLayerNetwork(conf).init(
+        input_shape=(input_size, input_size, conf.layers[0].n_in)
+    )

@@ -46,18 +46,41 @@ def apply_layer(layer, conf, params, state, x, rng, mask, kwargs, *,
                 train: bool, remat_prevent_cse: bool = True):
     """The shared per-layer application policy for both containers:
     mixed-precision casting (conf.dtype_policy) + remat-vs-plain dispatch
-    (conf.gradient_checkpointing). Output layers are never downcast
-    (softmax+loss numerics stay f32)."""
+    (conf.gradient_checkpointing). Never downcast: output layers
+    (softmax+loss numerics), and normalization layers (BN batch statistics
+    / LRN square-sums need f32 accumulations — standard mixed-precision
+    practice). Returned recurrent state is cast back to f32 so stored
+    states keep ONE dtype regardless of which API path produced them
+    (fit_batches' lax.scan carry requires dtype-stable states)."""
+    import jax
+    import jax.numpy as jnp
+
     from deeplearning4j_tpu.nn.layers.feedforward import OutputLayerImpl
+    from deeplearning4j_tpu.nn.layers.normalization import (
+        BatchNormalizationImpl,
+        LocalResponseNormalizationImpl,
+    )
 
     compute_dtype = compute_dtype_of(conf)
-    if compute_dtype is not None and not isinstance(layer, OutputLayerImpl):
+    cast_active = compute_dtype is not None and not isinstance(
+        layer,
+        (OutputLayerImpl, BatchNormalizationImpl, LocalResponseNormalizationImpl),
+    )
+    if cast_active:
         params, x = cast_for_compute(params, x, compute_dtype)
     if train and conf.gradient_checkpointing:
-        return remat_apply(layer, params, state, x, rng, mask, kwargs,
-                           prevent_cse=remat_prevent_cse)
-    return layer.apply(params, state, x, train=train, rng=rng, mask=mask,
-                       **kwargs)
+        y, new_state = remat_apply(layer, params, state, x, rng, mask, kwargs,
+                                   prevent_cse=remat_prevent_cse)
+    else:
+        y, new_state = layer.apply(params, state, x, train=train, rng=rng,
+                                   mask=mask, **kwargs)
+    if cast_active and new_state:
+        new_state = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32)
+            if a.dtype == compute_dtype else a,
+            new_state,
+        )
+    return y, new_state
 
 
 def cast_loss_input(x):

@@ -60,3 +60,52 @@ class TestCharRnn:
         x, y = next(model.batches(TEXT, batch=2, seq_len=32))
         model.net.fit(x, y)
         assert model.net.iteration - it0 == 4  # 32/8 windows
+
+
+class TestAlexNetVgg:
+    def test_alexnet_builds_and_steps(self):
+        from deeplearning4j_tpu.models.alexnet import build_alexnet
+
+        # small spatial variant for CPU test speed: 67 -> conv1 15 -> pool 7
+        net = build_alexnet(input_size=67, num_classes=10)
+        rng = np.random.default_rng(0)
+        x = rng.random((2, 67, 67, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 2)]
+        loss = float(net.fit(x, y))
+        assert np.isfinite(loss)
+        out = np.asarray(net.output(x))
+        assert out.shape == (2, 10)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_alexnet_227_param_count(self):
+        from deeplearning4j_tpu.models.alexnet import alexnet_conf
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(alexnet_conf(num_classes=1000)).init(
+            input_shape=(227, 227, 3)
+        )
+        # canonical single-tower AlexNet ~= 62.3M params
+        assert abs(net.num_params() - 62_378_344) < 1_000_000, net.num_params()
+
+    def test_vgg16_builds_and_steps(self):
+        from deeplearning4j_tpu.models.vgg import build_vgg16
+
+        net = build_vgg16(input_size=32, num_classes=10,
+                          gradient_checkpointing=True)
+        rng = np.random.default_rng(0)
+        x = rng.random((2, 32, 32, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 2)]
+        l1 = float(net.fit(x, y))
+        l2 = float(net.fit(x, y))
+        assert np.isfinite(l1) and np.isfinite(l2)
+        assert net.output(x).shape == (2, 10)
+
+    def test_vgg16_224_param_count(self):
+        from deeplearning4j_tpu.models.vgg import vgg16_conf
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        net = MultiLayerNetwork(vgg16_conf(num_classes=1000)).init(
+            input_shape=(224, 224, 3)
+        )
+        # canonical VGG-16: ~138.36M params
+        assert abs(net.num_params() - 138_357_544) < 1_000_000, net.num_params()

@@ -459,3 +459,57 @@ def test_performance_policy_preserves_embedding_indices():
     y = np.eye(2, dtype=np.float32)[[0, 1, 0]]
     loss = float(net.fit(idx, y))
     assert np.isfinite(loss)
+
+
+def test_performance_policy_bn_and_lstm_state_dtypes():
+    """Norm layers are excluded from bf16 casting (f32 batch statistics)
+    and recurrent states stay f32 across mixed-precision training, so
+    fit/fit_batches/rnn_time_step can interleave without dtype flips."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.layers import (
+        BatchNormalization, GravesLSTM, RnnOutputLayer,
+    )
+
+    vocab = 12
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(5).learning_rate(0.01).updater("adam")
+        .list()
+        .dtype_policy("performance")
+        .layer(0, GravesLSTM(n_in=vocab, n_out=16, activation="tanh"))
+        .layer(1, RnnOutputLayer(n_in=16, n_out=vocab, activation="softmax",
+                                 loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init(input_shape=(1, vocab))
+    eye = np.eye(vocab, dtype=np.float32)
+    ids = np.stack([(np.arange(9) + o) % vocab for o in range(4)])
+    x, y = eye[ids[:, :8]], eye[ids[:, 1:]]
+    float(net.fit(x, y))
+    for s in net.states:
+        for a in s.values():
+            assert a.dtype == jnp.float32, a.dtype
+    # fused path immediately after per-step path: scan carry stays stable
+    xs, ys = np.stack([x, x]), np.stack([y, y])
+    losses = net.fit_batches(xs, ys)
+    assert np.isfinite(losses).all()
+
+    # BN under performance policy: stats state stays f32, training is finite
+    conf_bn = (
+        NeuralNetConfiguration.builder()
+        .seed(5).learning_rate(0.01).updater("adam")
+        .list()
+        .dtype_policy("performance")
+        .layer(0, DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(1, BatchNormalization(n_out=8))
+        .layer(2, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    xb, yb = load_iris()
+    net_bn = MultiLayerNetwork(conf_bn).init()
+    loss = float(net_bn.fit(xb, yb))
+    assert np.isfinite(loss)
+    assert net_bn.states[1]["mean"].dtype == jnp.float32
+    assert net_bn.states[1]["var"].dtype == jnp.float32
