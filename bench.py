@@ -315,6 +315,103 @@ def bench_resnet50(batch=128, steps=10, input_size=224,
 
 
 # ---------------------------------------------------------------------------
+# beyond-reference flagship: transformer LM (tokens/sec + MFU + flash kernel)
+# ---------------------------------------------------------------------------
+
+
+def bench_transformer(batch=8, seq=1024, d_model=512, n_layers=8, heads=8,
+                      steps=8, dtype_policy="performance"):
+    """Decoder-only LM train throughput (models/transformer.py): the model
+    family whose scale needs the parallelism stack. Runs the flash-attention
+    pallas kernel when on TPU (ops/pallas_attention.py); MFU from
+    XLA-counted step FLOPs."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=8192, d_model=d_model, n_layers=n_layers, n_heads=heads,
+        d_ff=4 * d_model, max_len=seq, dtype_policy=dtype_policy,
+        learning_rate=1e-4,
+    )
+    lm = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    x = jax.device_put(jnp.asarray(toks[:, :-1], jnp.int32))
+    y = jax.device_put(jnp.asarray(toks[:, 1:], jnp.int32))
+
+    dt = _time_steps(lambda: lm.fit(x, y), 2, steps)
+    tokens_per_sec = batch * seq * steps / dt
+
+    flops = None
+    try:
+        lowered = lm._step.lower(lm.params, lm.opt, x, y)
+        cost = lowered.compile().cost_analysis()
+        if cost:
+            c = cost[0] if isinstance(cost, (list, tuple)) else cost
+            flops = float(c.get("flops", 0.0)) or None
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        _log(f"transformer cost_analysis unavailable: {e}")
+    mfu = None
+    if flops:
+        mfu = (flops / (dt / steps)) / _peak_flops_per_chip()
+    from deeplearning4j_tpu.ops.pallas_attention import flash_fits, pallas_enabled
+
+    return {
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "samples_per_sec": round(batch * steps / dt, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "step_flops": flops,
+        "flash_kernel": bool(pallas_enabled()
+                             and flash_fits(seq, d_model // heads)),
+        "batch": batch, "seq": seq, "d_model": d_model, "layers": n_layers,
+        "dtype_policy": dtype_policy,
+    }
+
+
+def bench_flash_attention(n=4, t=2048, h=8, d=64, steps=10):
+    """Flash pallas kernel vs dense XLA attention, same shapes, fwd only."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        flash_attention,
+        flash_fits,
+        pallas_enabled,
+    )
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(jnp.asarray(
+            rng.standard_normal((n, t, h, d)), jnp.bfloat16))
+        for _ in range(3)
+    )
+
+    # q/k/v as traced ARGS (a nullary closure would bake them in as
+    # jaxpr constants that XLA may fold away, timing nothing)
+    from deeplearning4j_tpu.ops.pallas_attention import dense_attention
+
+    dense_j = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+    dt_dense = _time_steps(lambda: dense_j(q, k, v), 2, steps)
+    out = {"dense_ms": round(dt_dense / steps * 1000, 3),
+           "shape": f"n{n} t{t} h{h} d{d}"}
+    if pallas_enabled() and flash_fits(t, d):
+        flash_j = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True))
+        dt_flash = _time_steps(lambda: flash_j(q, k, v), 2, steps)
+        out["flash_ms"] = round(dt_flash / steps * 1000, 3)
+        out["flash_speedup"] = round(dt_dense / dt_flash, 2)
+    else:
+        out["flash_ms"] = None
+        out["note"] = "pallas off or shape unfit; dense path only"
+    return out
+
+
+# ---------------------------------------------------------------------------
 # configs[3]: Word2Vec skip-gram negative sampling
 # ---------------------------------------------------------------------------
 
@@ -535,6 +632,8 @@ def main():
     run("resnet50", bench_resnet50, steps=3 if quick else 10)
     run("resnet50_bf16", bench_resnet50, steps=3 if quick else 10,
         dtype_policy="performance")
+    run("transformer_lm", bench_transformer, steps=3 if quick else 8)
+    run("flash_attention", bench_flash_attention, steps=3 if quick else 10)
     run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
     run("scaling_virtual8", bench_scaling)
     run("north_star", bench_north_star, steps=10 if quick else 100)

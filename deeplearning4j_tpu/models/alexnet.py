@@ -43,6 +43,10 @@ def alexnet_conf(
     s2 = p1                               # conv2 pad 2 keeps size
     p2 = (s2 - 3) // 2 + 1
     final = (p2 - 3) // 2 + 1            # pool5
+    if final < 1:
+        raise ValueError(
+            f"input_size {input_size} too small for the AlexNet stack "
+            f"(pool5 output would be {final}x{final}; minimum input is 63)")
     b = (
         NeuralNetConfiguration.builder()
         .seed(seed)

@@ -1,0 +1,478 @@
+"""Transformer language model — the multi-axis-parallel flagship.
+
+The reference's sequence models top out at GravesLSTM/GRU char-RNNs
+(reference nn/layers/recurrent/, models era 2016); this framework adds a
+decoder-only transformer LM as the flagship for the parallelism stack,
+because it is the model family whose scale actually NEEDS the mesh:
+
+  data axis   ('data')  : batch sharded — GSPMD inserts the gradient
+                          all-reduce (the ParallelWrapper/param-averaging
+                          successor, SURVEY.md section 2.7).
+  model axis  ('model') : Megatron column/row sharding of every attention
+                          and MLP matrix (parallel/tensor_parallel.py has
+                          the explicit shard_map formulation; HERE the same
+                          layout is expressed as GSPMD sharding annotations
+                          and XLA derives the identical psum schedule —
+                          the scaling-book recipe: pick a mesh, annotate,
+                          let the compiler insert collectives).
+  expert axis ('expert'): optional MoE FFN blocks, experts sharded
+                          (parallel/expert_parallel.py math, GSPMD layout).
+  seq axis    ('seq')   : ring attention for sequences beyond one chip's
+                          HBM (parallel/sequence_parallel.py), used by
+                          `ring_forward`.
+
+Everything under `train_step` is ONE jitted XLA program: forward, backward,
+Adam update, with bf16 MXU matmuls when dtype_policy="performance".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    d_model: int = 128
+    n_layers: int = 4
+    n_heads: int = 4
+    d_ff: int = 512
+    max_len: int = 256
+    moe_experts: int = 0          # 0 = dense FFN; >0 = MoE every block
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 1e-2
+    dtype_policy: str = "strict"  # "strict" f32 | "performance" bf16 compute
+    learning_rate: float = 3e-4
+    seed: int = 0
+    # flash-attention pallas kernel (ops/pallas_attention.py) on the
+    # single-device path; the GSPMD-sharded path always uses dense XLA
+    # attention (pallas custom calls don't auto-partition under GSPMD —
+    # multi-chip attention goes through ring_forward instead)
+    use_flash: bool = True
+
+    @property
+    def compute_dtype(self):
+        return jnp.bfloat16 if self.dtype_policy == "performance" else jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Init + sharding layout
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: TransformerConfig) -> Params:
+    """Global-shaped params; block leaves stacked on a leading layer dim [L,...]
+    so the forward is a lax.scan over layers (compile time O(1) in depth)."""
+    key = jax.random.PRNGKey(cfg.seed)
+    ks = jax.random.split(key, 10)
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+
+    def norm(k, shape, scale):
+        # float(scale): numpy f64 scalars are strongly typed and would
+        # promote the whole tree to f64 under jax_enable_x64
+        return jax.random.normal(k, shape, jnp.float32) * float(scale)
+
+    def xavier(k, shape):
+        return norm(k, shape, np.sqrt(2.0 / (shape[-2] + shape[-1])))
+
+    def ones(shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def zeros(shape):
+        return jnp.zeros(shape, jnp.float32)
+
+    blocks = {
+        "ln1_g": ones((L, d)), "ln1_b": zeros((L, d)),
+        "Wq": xavier(ks[0], (L, d, d)), "Wk": xavier(ks[1], (L, d, d)),
+        "Wv": xavier(ks[2], (L, d, d)),
+        # residual-branch output projections scaled down by depth (GPT-2 style)
+        "Wo": norm(ks[3], (L, d, d), 0.02 / np.sqrt(2 * L)),
+        "ln2_g": ones((L, d)), "ln2_b": zeros((L, d)),
+    }
+    if cfg.moe_experts:
+        E = cfg.moe_experts
+        blocks.update({
+            "Wg": xavier(ks[4], (L, d, E)),
+            "W1": xavier(ks[5], (L, E, d, f)), "b1": zeros((L, E, f)),
+            "W2": norm(ks[6], (L, E, f, d), 0.02 / np.sqrt(2 * L)),
+            "b2": zeros((L, E, d)),
+        })
+    else:
+        blocks.update({
+            "W1": xavier(ks[5], (L, d, f)), "b1": zeros((L, f)),
+            "W2": norm(ks[6], (L, f, d), 0.02 / np.sqrt(2 * L)),
+            "b2": zeros((L, d)),
+        })
+    return {
+        "embed": norm(ks[7], (cfg.vocab_size, d), 0.02),
+        "pos": norm(ks[8], (cfg.max_len, d), 0.01),
+        "lnf_g": ones((d,)), "lnf_b": zeros((d,)),
+        "blocks": blocks,
+        # lm head tied to embed (reference EmbeddingLayer has no tying, but
+        # tying is the modern default and halves the biggest matrix)
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Params:
+    """Megatron PartitionSpecs (leading layer dim unsharded). Column-parallel
+    weights shard the output dim over 'model'; row-parallel the input dim;
+    MoE expert leaves additionally shard the expert dim over 'expert'."""
+    col, row = P(None, None, MODEL_AXIS), P(None, MODEL_AXIS, None)
+    blocks = {
+        "ln1_g": P(), "ln1_b": P(),
+        "Wq": col, "Wk": col, "Wv": col, "Wo": row,
+        "ln2_g": P(), "ln2_b": P(),
+    }
+    if cfg.moe_experts:
+        blocks.update({
+            "Wg": P(),
+            "W1": P(None, EXPERT_AXIS, None, MODEL_AXIS),
+            "b1": P(None, EXPERT_AXIS, MODEL_AXIS),
+            "W2": P(None, EXPERT_AXIS, MODEL_AXIS, None),
+            "b2": P(None, EXPERT_AXIS, None),
+        })
+    else:
+        blocks.update({"W1": col, "b1": P(None, MODEL_AXIS),
+                       "W2": row, "b2": P()})
+    return {
+        "embed": P(None, MODEL_AXIS),
+        "pos": P(),
+        "lnf_g": P(), "lnf_b": P(),
+        "blocks": blocks,
+    }
+
+
+def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, jnp.ndarray),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, g, b, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+
+def _attention(q, k, v, n_heads, use_flash=False):
+    n, t, d = q.shape
+    hd = d // n_heads
+    q = q.reshape(n, t, n_heads, hd)
+    k = k.reshape(n, t, n_heads, hd)
+    v = v.reshape(n, t, n_heads, hd)
+    if use_flash:
+        # single dispatch policy lives in attention_auto (flash when the
+        # pallas gate + VMEM fit allow, dense XLA otherwise)
+        from deeplearning4j_tpu.ops.pallas_attention import attention_auto
+
+        return attention_auto(q, k, v, causal=True).reshape(n, t, d)
+    s = jnp.einsum("nqhd,nkhd->nhqk", q, k) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    s = jnp.where(mask[None, None], s, jnp.asarray(-1e9, s.dtype))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("nhqk,nkhd->nqhd", p, v).reshape(n, t, d)
+
+
+def _moe_ffn(bp, h, cfg: TransformerConfig):
+    """MoE FFN: routing + expert math shared with parallel/expert_parallel
+    (called inline, not through its shard_map, so GSPMD shards the expert
+    dim via the param shardings; returns (out, aux_loss))."""
+    from deeplearning4j_tpu.parallel.expert_parallel import (
+        _routing,
+        aux_loss_from_gates,
+        expert_mlp,
+    )
+
+    n, t, d = h.shape
+    xt = h.reshape(n * t, d)
+    gates = jax.nn.softmax((xt @ bp["Wg"]).astype(jnp.float32), axis=-1)
+    capacity = max(1, int(cfg.moe_capacity_factor * n * t * cfg.moe_top_k
+                          / cfg.moe_experts))
+    dispatch, combine = _routing(gates, cfg.moe_top_k, capacity)
+    y = expert_mlp(bp["W1"], bp["b1"], bp["W2"], bp["b2"],
+                   dispatch.astype(h.dtype), combine.astype(h.dtype), xt)
+    return y.reshape(n, t, d), aux_loss_from_gates(gates)
+
+
+def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+            ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [N, T] int32 -> (logits [N, T, V] f32, aux_loss scalar)."""
+    cdt = cfg.compute_dtype
+    n, t = tokens.shape
+    h = params["embed"][tokens] + params["pos"][:t][None]
+    h = h.astype(cdt)
+
+    def block(carry, bp):
+        h, aux = carry
+        x = _ln(h, bp["ln1_g"].astype(cdt), bp["ln1_b"].astype(cdt))
+        q, k, v = x @ bp["Wq"].astype(cdt), x @ bp["Wk"].astype(cdt), \
+            x @ bp["Wv"].astype(cdt)
+        h = h + _attention(q, k, v, cfg.n_heads,
+                           use_flash=cfg.use_flash) @ bp["Wo"].astype(cdt)
+        x = _ln(h, bp["ln2_g"].astype(cdt), bp["ln2_b"].astype(cdt))
+        if cfg.moe_experts:
+            bp16 = {kk: vv.astype(cdt) for kk, vv in bp.items()}
+            y, a = _moe_ffn(bp16, x, cfg)
+            h = h + y
+            aux = aux + a
+        else:
+            inner = jax.nn.gelu(x @ bp["W1"].astype(cdt) + bp["b1"].astype(cdt))
+            h = h + inner @ bp["W2"].astype(cdt) + bp["b2"].astype(cdt)
+        return (h, aux), None
+
+    (h, aux), _ = lax.scan(block, (h, jnp.zeros((), jnp.float32)),
+                           params["blocks"])
+    h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    logits = h @ params["embed"].T  # tied head
+    return logits.astype(jnp.float32), aux / cfg.n_layers
+
+
+def loss_fn(params: Params, tokens: jax.Array, targets: jax.Array,
+            cfg: TransformerConfig) -> jax.Array:
+    logits, aux = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    return nll + cfg.moe_aux_coef * aux
+
+
+# ---------------------------------------------------------------------------
+# Training (one jitted step; Adam)
+# ---------------------------------------------------------------------------
+
+
+def init_opt_state(params: Params) -> Params:
+    z = lambda a: jnp.zeros_like(a)
+    return {
+        "m": jax.tree_util.tree_map(z, params),
+        "v": jax.tree_util.tree_map(z, params),
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
+    t = opt["t"] + 1
+    m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
+                               opt["m"], grads)
+    v = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                               opt["v"], grads)
+    tf = t.astype(jnp.float32)
+    corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+    new = jax.tree_util.tree_map(
+        lambda p, m, v: p - lr * corr * m / (jnp.sqrt(v) + eps),
+        params, m, v)
+    return new, {"m": m, "v": v, "t": t}
+
+
+def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+    """Returns step(params, opt, tokens, targets) -> (params, opt, loss),
+    jitted. With a mesh: params carry Megatron/MoE shardings, the batch is
+    sharded over 'data', and GSPMD derives the full DP x TP x EP collective
+    schedule (gradient all-reduce over 'data'; the two per-block psums over
+    'model'; expert all-to-alls over 'expert')."""
+
+    def step(params, opt, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)
+        params, opt = _adam_update(params, grads, opt, cfg.learning_rate)
+        return params, opt, loss
+
+    if mesh is None:
+        return jax.jit(step)
+    specs = param_specs(cfg)
+    pshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                    is_leaf=lambda x: isinstance(x, P))
+    oshard = {"m": pshard, "v": pshard,
+              "t": NamedSharding(mesh, P())}
+    dshard = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        step,
+        in_shardings=(pshard, oshard, dshard, dshard),
+        out_shardings=(pshard, oshard, NamedSharding(mesh, P())),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Ring-attention (sequence-parallel) forward for long context
+# ---------------------------------------------------------------------------
+
+
+def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+                 mesh: Mesh) -> jax.Array:
+    """Forward with attention computed as a RING over the 'seq' mesh axis
+    (parallel/sequence_parallel.py): exact full attention for sequences
+    sharded over devices. Used for long-context inference/eval."""
+    from deeplearning4j_tpu.parallel.sequence_parallel import (
+        ring_attention_sharded,
+    )
+
+    n, t = tokens.shape
+    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
+    L = params["blocks"]["Wq"].shape[0]
+    hd = cfg.d_model // cfg.n_heads
+    for i in range(L):
+        bp = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
+        x = _ln(h, bp["ln1_g"], bp["ln1_b"])
+        q = (x @ bp["Wq"]).reshape(n, t, cfg.n_heads, hd)
+        k = (x @ bp["Wk"]).reshape(n, t, cfg.n_heads, hd)
+        v = (x @ bp["Wv"]).reshape(n, t, cfg.n_heads, hd)
+        att = ring_attention_sharded(q, k, v, mesh, causal=True)
+        h = h + att.reshape(n, t, cfg.d_model) @ bp["Wo"]
+        x = _ln(h, bp["ln2_g"], bp["ln2_b"])
+        if cfg.moe_experts:
+            y, _ = _moe_ffn(bp, x, cfg)
+            h = h + y
+        else:
+            h = h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] + bp["b2"]
+    h = _ln(h, params["lnf_g"], params["lnf_b"])
+    return h @ params["embed"].T
+
+
+# ---------------------------------------------------------------------------
+# Convenience wrapper
+# ---------------------------------------------------------------------------
+
+
+class TransformerLM:
+    """Flagship LM with the framework's fit/generate surface."""
+
+    def __init__(self, cfg: TransformerConfig, mesh: Optional[Mesh] = None):
+        self.cfg = cfg  # the user's config — persisted verbatim by save()
+        # runtime config: flash is disabled under a mesh (pallas custom
+        # calls don't auto-partition under GSPMD; multi-chip attention is
+        # ring_forward's job) WITHOUT mutating cfg, so a mesh-trained
+        # checkpoint reloaded on one device gets its flash path back
+        self._run_cfg = (dataclasses.replace(cfg, use_flash=False)
+                         if mesh is not None else cfg)
+        self.mesh = mesh
+        self.params = init_params(cfg)
+        if mesh is not None:
+            self.params = shard_params(self.params, cfg, mesh)
+        self.opt = init_opt_state(self.params)
+        self._step = make_train_step(self._run_cfg, mesh)
+        self._gen_cache: Dict[int, Any] = {}
+
+    def fit(self, tokens: jax.Array, targets: jax.Array) -> jax.Array:
+        self.params, self.opt, loss = self._step(
+            self.params, self.opt, tokens, targets)
+        return loss
+
+    def logits(self, tokens: jax.Array) -> jax.Array:
+        return forward(self.params, tokens, self._run_cfg)[0]
+
+    def save(self, path: str) -> None:
+        """Checkpoint in the framework's ModelSerializer zip layout
+        (utils/serialization.py — reference ModelSerializer.java:70-110
+        three-part semantic: configuration + coefficients + updater)."""
+        import json
+        import zipfile
+
+        from deeplearning4j_tpu.utils.serialization import (
+            FORMAT_VERSION,
+            _tree_to_npz_bytes,
+        )
+
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("configuration.json",
+                       json.dumps(dataclasses.asdict(self.cfg)))
+            z.writestr("coefficients.npz", _tree_to_npz_bytes(self.params))
+            z.writestr("updater.npz", _tree_to_npz_bytes(self.opt))
+            z.writestr("metadata.json", json.dumps({
+                "format_version": FORMAT_VERSION,
+                "model_class": "TransformerLM",
+            }))
+
+    @classmethod
+    def load(cls, path: str, mesh: Optional[Mesh] = None,
+             load_updater: bool = True) -> "TransformerLM":
+        import json
+        import zipfile
+
+        from deeplearning4j_tpu.utils.serialization import (
+            _npz_bytes_into_tree,
+        )
+
+        with zipfile.ZipFile(path, "r") as z:
+            cfg = TransformerConfig(
+                **json.loads(z.read("configuration.json").decode()))
+            lm = cls(cfg, mesh=mesh)
+            lm.params = _npz_bytes_into_tree(z.read("coefficients.npz"),
+                                             lm.params)
+            if load_updater and "updater.npz" in z.namelist():
+                lm.opt = _npz_bytes_into_tree(z.read("updater.npz"), lm.opt)
+        if mesh is not None:
+            lm.params = shard_params(lm.params, cfg, mesh)
+        return lm
+
+    def _sample_fn(self, n_new: int):
+        """Jitted sampler, cached per n_new (a fresh @jax.jit closure per
+        generate() call would recompile every time); temperature and key are
+        traced args so they never force recompiles. The token buffer keeps
+        the prompt at positions 0..t-1 (RIGHT-padded with zeros that causal
+        masking makes invisible), so position embeddings match training —
+        left-padding would condition sampling on a fake zero-token prefix."""
+        cached = self._gen_cache.get(n_new)
+        if cached is not None:
+            return cached
+        cfg = self._run_cfg
+
+        @jax.jit
+        def sample(params, buf, pos0, key, temperature):
+            def one(carry, i):
+                buf, key = carry
+                logits, _ = forward(params, buf, cfg)
+                pos = pos0 + i  # next write index; condition on pos-1
+                last = jnp.take_along_axis(
+                    logits, (pos - 1)[None, None, None].repeat(
+                        buf.shape[0], 0), axis=1)[:, 0]
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, last / jnp.maximum(temperature, 1e-6))
+                buf = lax.dynamic_update_slice_in_dim(
+                    buf, nxt[:, None].astype(buf.dtype), pos, axis=1)
+                return (buf, key), nxt
+
+            (_, _), out = lax.scan(one, (buf, key), jnp.arange(n_new))
+            return out.T  # [N, n_new]
+
+        self._gen_cache[n_new] = sample
+        return sample
+
+    def generate(self, prompt: jax.Array, n_new: int, temperature: float = 1.0,
+                 seed: int = 0) -> jax.Array:
+        """Sample n_new tokens after the prompt (static shapes throughout:
+        one compile per n_new). prompt len + n_new must fit max_len; longer
+        prompts keep their last (max_len - n_new) tokens."""
+        cfg = self._run_cfg
+        if n_new >= cfg.max_len:
+            raise ValueError(f"n_new {n_new} must be < max_len {cfg.max_len}")
+        t = prompt.shape[1]
+        keep = min(t, cfg.max_len - n_new)
+        window = prompt[:, t - keep:]
+        buf = jnp.pad(window, ((0, 0), (0, cfg.max_len - keep)))
+        return self._sample_fn(n_new)(
+            self.params, buf, jnp.asarray(keep, jnp.int32),
+            jax.random.PRNGKey(seed), jnp.asarray(temperature, jnp.float32))

@@ -68,6 +68,11 @@ def apply_layer(layer, conf, params, state, x, rng, mask, kwargs, *,
     )
     if cast_active:
         params, x = cast_for_compute(params, x, compute_dtype)
+    elif compute_dtype is not None and x.dtype == compute_dtype:
+        # excluded layer (output/BN/LRN) fed by a cast layer: UPcast the
+        # incoming activations so batch statistics / square-sums really
+        # accumulate in f32 — merely skipping the downcast is not enough
+        x = x.astype(jnp.float32)
     if train and conf.gradient_checkpointing:
         y, new_state = remat_apply(layer, params, state, x, rng, mask, kwargs,
                                    prevent_cse=remat_prevent_cse)

@@ -1,0 +1,176 @@
+"""Pallas TPU flash-attention kernel.
+
+Dense attention materializes the [T, T] score matrix in HBM per (batch,
+head) — at T=4096 that is 64MB of f32 traffic each way, and HBM bandwidth
+(not MXU FLOPs) bounds the op. The kernel below never materializes scores:
+each q block stays in VMEM while k/v blocks stream through an online-softmax
+accumulation (running max + denominator), so HBM traffic drops from
+O(T^2) to O(T * D) per row — the flash-attention recipe, written per
+/opt/skills/guides/pallas_guide.md.
+
+The reference has no attention at all (2016 — SURVEY.md section 2.7: its
+only long-sequence mechanism is truncated BPTT); attention enters this
+framework via the MultiHeadAttention layer conf and the transformer
+flagship (models/transformer.py), and THIS kernel is their TPU hot path.
+The multi-chip path (ring attention over the 'seq' axis,
+parallel/sequence_parallel.py) composes with it: the ring rotates K/V
+shards between chips while each chip's local block product can run through
+this kernel.
+
+Scope & fallback policy (mirrors ops/pallas_kernels.py):
+  - forward only; backward is jax autodiff through the dense reference via
+    custom_vjp recompute (same gradients, fwd at kernel speed);
+  - causal and full attention; no padding mask (masked batches fall back);
+  - engages when pallas is enabled (ops.pallas_kernels.pallas_enabled) and
+    the k/v rows fit VMEM (flash_fits); else dense XLA attention;
+  - CPU tests run the same kernel under interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from deeplearning4j_tpu.ops.pallas_kernels import pallas_enabled
+
+_BLOCK_Q = 128
+_BLOCK_K = 128
+# K + V resident per (batch, head): 2 * T * D floats; budget well under the
+# ~16MB/core VMEM, leaving room for the double-buffered q/o blocks + scratch.
+_KV_BUDGET_FLOATS = 1_500_000
+
+
+def flash_fits(t: int, d: int) -> bool:
+    return (t % _BLOCK_Q == 0 and t % _BLOCK_K == 0
+            and 2 * t * d <= _KV_BUDGET_FLOATS)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, scale: float,
+                  block_k: int):
+    """One q block vs all k/v blocks of one (batch*head) row.
+    q_ref/o_ref: [1, Bq, D]; k_ref/v_ref: [1, T, D]."""
+    q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1) * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+        if causal:
+            ki = j * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+            s = jnp.where(qi >= ki, s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # a fully-masked block leaves m_new at -inf on no row in the causal
+        # case (the diagonal is always visible); guard anyway for the loop
+        # iterations before any visible key
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))
+        return m_new, l, acc
+
+    if causal:
+        # keys strictly after this q block's last row never contribute
+        n_blocks = (pl.program_id(1) * bq + bq + block_k - 1) // block_k
+    else:
+        n_blocks = t // block_k
+    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_raw(q, k, v, *, causal: bool, interpret: bool):
+    """q,k,v: [B, T, D] (B = batch*heads) -> [B, T, D]."""
+    b, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    grid = (b, t // _BLOCK_Q)
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, causal=causal, scale=scale,
+                          block_k=_BLOCK_K),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK_Q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _BLOCK_Q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_reference(q, k, v, *, causal: bool):
+    """XLA dense attention on [B, T, D] (autodiff oracle + fallback)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) / (d ** 0.5)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    return _flash_raw(q, k, v, causal=causal, interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash_raw(q, k, v, causal=causal, interpret=interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _apply_folded(fn, q, k, v):
+    """Run fn on [N*H, T, D]-folded q/k/v and unfold back to [N, T, H, D]."""
+    n, t, h, d = q.shape
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(n * h, t, d)
+    out = fn(fold(q), fold(k), fold(v))
+    return out.reshape(n, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    interpret: bool = False) -> jax.Array:
+    """q,k,v: [N, T, H, D] -> [N, T, H, D] softmax attention, flash kernel."""
+    return _apply_folded(
+        lambda q, k, v: _flash(q, k, v, causal, interpret), q, k, v)
+
+
+def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
+    """q,k,v: [N, T, H, D] -> [N, T, H, D] dense XLA attention (the fallback
+    path and the flash kernel's equivalence oracle)."""
+    return _apply_folded(
+        lambda q, k, v: _dense_reference(q, k, v, causal=causal), q, k, v)
+
+
+def attention_auto(q, k, v, *, causal: bool = False) -> jax.Array:
+    """Backend registry slot (the reference's reflective cuDNN-helper
+    pattern, ConvolutionLayer.java:64-70): flash kernel when pallas is on
+    and the shape fits VMEM, dense XLA attention otherwise."""
+    t, d = q.shape[1], q.shape[3]
+    if pallas_enabled() and flash_fits(t, d):
+        return flash_attention(q, k, v, causal=causal)
+    return dense_attention(q, k, v, causal=causal)

@@ -1,0 +1,139 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a 'pipe' axis.
+
+The reference has NO pipeline parallelism (SURVEY.md section 2.7 — absent;
+2016 model scale). Here depth-wise model sharding is first-class: the layer
+stack is split into S shape-preserving stages, one per device along the
+mesh's 'pipe' axis; a batch is split into M microbatches that flow through
+the ring, activations hopping stage->stage via `ppermute` over ICI.
+
+Schedule (GPipe): T = M + S - 1 ticks. At tick t, stage s processes
+microbatch t - s (when 0 <= t - s < M). Every device computes every tick
+(bubble ticks compute garbage that is masked out) — under jit this is a
+single `lax.scan` whose body is pure SPMD compute + one ppermute, which XLA
+overlaps with the next tick's compute.
+
+The whole schedule is differentiable: `jax.grad` through `pipeline_apply`
+yields the exact full-model gradient (scan transposes to the reverse
+schedule; ppermute transposes to the reverse ring hop), so the backward
+pipeline emerges from autodiff instead of hand-written 1F1B plumbing.
+
+Stage params live as a pytree whose leaves carry a leading stage dim [S, ...]
+sharded over 'pipe' — each device holds only its own stage's weights
+(`shard_pipeline_params`), which is the point: the model can be S x larger
+than one chip's HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from deeplearning4j_tpu.parallel.mesh import PIPELINE_AXIS
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def shard_pipeline_params(params: Any, mesh: Mesh,
+                          axis: str = PIPELINE_AXIS) -> Any:
+    """Place stage-stacked params ([S, ...] leaves) so each device along the
+    pipe axis holds one stage's slice."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            a, NamedSharding(mesh, P(axis, *(None,) * (a.ndim - 1)))
+        ),
+        params,
+    )
+
+
+def _pipeline_body(params: Any, x: jax.Array, *, stage_fn: StageFn,
+                   n_micro: int, axis: str):
+    """Per-device body. params leaves: [1, ...] (my stage, leading dim kept
+    by shard_map); x: [M, mb, ...] microbatched input, replicated."""
+    my_params = jax.tree_util.tree_map(lambda a: a[0], params)
+    stage = lax.axis_index(axis)
+    n_stages = lax.psum(1, axis)
+    n_ticks = n_micro + n_stages - 1  # static: mesh size is trace-constant
+
+    outputs = jnp.zeros_like(x)
+    recv = jnp.zeros_like(x[0])
+    # ring hop: stage s -> s+1 (last stage's send is dropped into stage 0's
+    # recv buffer, where it is ignored — stage 0 reads from x instead)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        mb_idx = jnp.clip(t - stage, 0, n_micro - 1)
+        inp = jnp.where(stage == 0,
+                        lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
+                                                 keepdims=False),
+                        recv)
+        y = stage_fn(my_params, inp)
+        valid = (t - stage >= 0) & (t - stage < n_micro)
+        outputs = jnp.where(
+            valid,
+            lax.dynamic_update_index_in_dim(outputs, y, mb_idx, 0),
+            outputs,
+        )
+        recv = lax.ppermute(y, axis, perm)
+        return (recv, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (recv, outputs), jnp.arange(n_ticks))
+    # only the LAST stage's output buffer is the model output; mask + psum
+    # replicates it to every device
+    return lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis,
+    )
+
+
+def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
+                   stage_fn: StageFn, n_micro: int,
+                   axis: str = PIPELINE_AXIS) -> jax.Array:
+    """Run the pipelined model.
+
+    params: pytree with leading stage dim [S, ...] on every leaf (S = pipe
+            axis size), sharded or shardable per `shard_pipeline_params`.
+    x:      [B, ...] global batch; B must divide into n_micro microbatches.
+    stage_fn(stage_params, mb) -> mb must preserve the microbatch shape
+            (equal-width stages — the transformer-block case).
+    Returns [B, ...] output, replicated."""
+    s = mesh.shape[axis]
+    bad = [a.shape[0] for a in jax.tree_util.tree_leaves(params)
+           if a.shape[0] != s]
+    if bad:
+        raise ValueError(
+            f"stage-stacked params have leading dims {bad}; every leaf must "
+            f"have leading dim == pipe-axis size {s}")
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+    param_specs = jax.tree_util.tree_map(
+        lambda a: P(axis, *(None,) * (a.ndim - 1)), params
+    )
+    fn = shard_map(
+        partial(_pipeline_body, stage_fn=stage_fn, n_micro=n_micro, axis=axis),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = fn(params, xm)
+    return out.reshape((b,) + out.shape[2:])
+
+
+def pipeline_reference(params: Any, x: jax.Array, *, stage_fn: StageFn,
+                       n_stages: int) -> jax.Array:
+    """Serial reference: run the S stages in sequence on one device (the
+    pipelined result must match this exactly)."""
+    y = x
+    for s in range(n_stages):
+        my = jax.tree_util.tree_map(lambda a, s=s: a[s], params)
+        y = stage_fn(my, y)
+    return y

@@ -161,6 +161,13 @@ def mha_apply(params, x, num_heads: int, *, causal: bool = False,
     q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
     if mesh is not None and SEQ_AXIS in mesh.shape:
         att = ring_attention_sharded(q, k, v, mesh, causal=causal)
+    elif key_mask is None:
+        # mask-free single-device path: flash pallas kernel when on TPU and
+        # the shape fits VMEM, dense XLA otherwise (one dispatch policy —
+        # ops/pallas_attention.attention_auto)
+        from deeplearning4j_tpu.ops.pallas_attention import attention_auto
+
+        att = attention_auto(q, k, v, causal=causal)
     else:
         att = multi_head_attention(q, k, v, causal=causal, key_mask=key_mask)
     return att.reshape(n, t, proj) @ params["Wo"]

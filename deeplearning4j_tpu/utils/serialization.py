@@ -136,4 +136,8 @@ class ModelSerializer:
             meta = json.loads(z.read("metadata.json").decode())
         if meta.get("model_class") == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path, load_updater)
+        if meta.get("model_class") == "TransformerLM":
+            from deeplearning4j_tpu.models.transformer import TransformerLM
+
+            return TransformerLM.load(path, load_updater=load_updater)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)

@@ -1,0 +1,315 @@
+"""Tensor / pipeline / expert parallelism == serial equivalence.
+
+Mirrors the reference's distributed-without-a-cluster strategy (SURVEY.md
+section 4, TestCompareParameterAveragingSparkVsSingleMachine.java:115-262:
+exact equality of the distributed and single-machine paths) for the three
+parallelism modes the reference never had (SURVEY.md section 2.7): each mode
+must reproduce the single-device math on the virtual 8-device CPU mesh, and
+its gradients must match the serial gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.mesh import (
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    device_mesh,
+)
+
+jtu = jax.tree_util
+
+
+def _mesh(axis, n=4):
+    return device_mesh(num_devices=n, axis_names=(axis,))
+
+
+# ---------------------------------------------------------------------------
+# Tensor parallelism
+# ---------------------------------------------------------------------------
+
+
+class TestTensorParallel:
+    def _setup(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            init_tp_block_params,
+        )
+
+        key = jax.random.PRNGKey(0)
+        params = init_tp_block_params(key, d_model=32, d_ff=64, num_heads=4)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 32))
+        return params, x
+
+    def test_block_matches_serial(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            tp_block_apply,
+            tp_block_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(MODEL_AXIS)
+        y_tp = tp_block_apply(params, x, mesh, num_heads=4, causal=True)
+        y_ref = tp_block_reference(params, x, num_heads=4, causal=True)
+        np.testing.assert_allclose(np.asarray(y_tp), np.asarray(y_ref),
+                                   atol=1e-5)
+
+    def test_gradients_match_serial(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            tp_block_apply,
+            tp_block_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(MODEL_AXIS)
+
+        def loss_tp(p):
+            return jnp.sum(tp_block_apply(p, x, mesh, num_heads=4) ** 2)
+
+        def loss_ref(p):
+            return jnp.sum(tp_block_reference(p, x, num_heads=4) ** 2)
+
+        g_tp = jax.grad(loss_tp)(params)
+        g_ref = jax.grad(loss_ref)(params)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(g_tp[k]), np.asarray(g_ref[k]), atol=1e-3,
+                err_msg=f"grad mismatch for {k}",
+            )
+
+    def test_sharded_placement(self):
+        """shard_tp_params actually splits the big matrices over the axis."""
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            shard_tp_params,
+        )
+
+        params, _ = self._setup()
+        mesh = _mesh(MODEL_AXIS)
+        sp = shard_tp_params(params, mesh)
+        shard = sp["W1"].addressable_shards[0]
+        assert shard.data.shape == (32, 64 // 4)
+
+    def test_column_row_dense_roundtrip(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import (
+            column_parallel_dense,
+            row_parallel_dense,
+        )
+
+        mesh = _mesh(MODEL_AXIS)
+        key = jax.random.PRNGKey(2)
+        k1, k2, k3 = jax.random.split(key, 3)
+        W1 = jax.random.normal(k1, (16, 32))
+        b1 = jnp.zeros((32,))
+        W2 = jax.random.normal(k2, (32, 16))
+        b2 = jnp.zeros((16,))
+        x = jax.random.normal(k3, (4, 16))
+        h = column_parallel_dense(W1, b1, x, mesh, gather=False)
+        y = row_parallel_dense(W2, b2, h, mesh)
+        y_ref = (x @ W1 + b1) @ W2 + b2
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    def test_heads_not_divisible_raises(self):
+        from deeplearning4j_tpu.parallel.tensor_parallel import tp_block_apply
+
+        params, x = self._setup()
+        mesh = _mesh(MODEL_AXIS, n=8)
+        with pytest.raises(ValueError):
+            tp_block_apply(params, x, mesh, num_heads=4)  # 4 heads, 8 devices
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism
+# ---------------------------------------------------------------------------
+
+
+def _mlp_stage(p, x):
+    return jnp.tanh(x @ p["W"] + p["b"])
+
+
+class TestPipelineParallel:
+    def _setup(self, n_stages=4, width=16):
+        key = jax.random.PRNGKey(0)
+        k1, k2, k3 = jax.random.split(key, 3)
+        params = {
+            "W": jax.random.normal(k1, (n_stages, width, width)) * 0.3,
+            "b": jax.random.normal(k2, (n_stages, width)) * 0.1,
+        }
+        x = jax.random.normal(k3, (8, width))
+        return params, x
+
+    def test_matches_serial(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            pipeline_apply,
+            pipeline_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(PIPELINE_AXIS)
+        y = pipeline_apply(params, x, mesh, stage_fn=_mlp_stage, n_micro=4)
+        y_ref = pipeline_reference(params, x, stage_fn=_mlp_stage, n_stages=4)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    def test_micro_not_dividing_batch_raises(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            pipeline_apply,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(PIPELINE_AXIS)
+        with pytest.raises(ValueError):
+            pipeline_apply(params, x, mesh, stage_fn=_mlp_stage, n_micro=3)
+
+    def test_gradients_match_serial(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            pipeline_apply,
+            pipeline_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(PIPELINE_AXIS)
+
+        def loss_pp(p):
+            return jnp.sum(
+                pipeline_apply(p, x, mesh, stage_fn=_mlp_stage, n_micro=4) ** 2
+            )
+
+        def loss_ref(p):
+            return jnp.sum(
+                pipeline_reference(p, x, stage_fn=_mlp_stage, n_stages=4) ** 2
+            )
+
+        g_pp = jax.grad(loss_pp)(params)
+        g_ref = jax.grad(loss_ref)(params)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(g_pp[k]), np.asarray(g_ref[k]), atol=1e-4,
+                err_msg=f"grad mismatch for {k}",
+            )
+
+    def test_more_micro_than_stages(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            pipeline_apply,
+            pipeline_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(PIPELINE_AXIS)
+        y = pipeline_apply(params, x, mesh, stage_fn=_mlp_stage, n_micro=8)
+        y_ref = pipeline_reference(params, x, stage_fn=_mlp_stage, n_stages=4)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    def test_param_placement(self):
+        from deeplearning4j_tpu.parallel.pipeline_parallel import (
+            shard_pipeline_params,
+        )
+
+        params, _ = self._setup()
+        mesh = _mesh(PIPELINE_AXIS)
+        sp = shard_pipeline_params(params, mesh)
+        assert sp["W"].addressable_shards[0].data.shape == (1, 16, 16)
+
+
+# ---------------------------------------------------------------------------
+# Expert parallelism
+# ---------------------------------------------------------------------------
+
+
+class TestExpertParallel:
+    def _setup(self, n_experts=8):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            init_moe_params,
+        )
+
+        params = init_moe_params(jax.random.PRNGKey(0), d_model=16, d_ff=32,
+                                 n_experts=n_experts)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 12, 16))
+        return params, x
+
+    def test_matches_serial(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply,
+            moe_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(EXPERT_AXIS)
+        y = moe_apply(params, x, mesh, top_k=2)
+        y_ref = moe_reference(params, x, top_k=2)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    def test_gradients_match_serial(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply,
+            moe_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(EXPERT_AXIS)
+
+        def loss_ep(p):
+            return jnp.sum(moe_apply(p, x, mesh, top_k=2) ** 2)
+
+        def loss_ref(p):
+            return jnp.sum(moe_reference(p, x, top_k=2) ** 2)
+
+        g_ep = jax.grad(loss_ep)(params)
+        g_ref = jax.grad(loss_ref)(params)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(g_ep[k]), np.asarray(g_ref[k]), atol=1e-4,
+                err_msg=f"grad mismatch for {k}",
+            )
+
+    def test_top1_routing(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            moe_apply,
+            moe_reference,
+        )
+
+        params, x = self._setup()
+        mesh = _mesh(EXPERT_AXIS)
+        y = moe_apply(params, x, mesh, top_k=1)
+        y_ref = moe_reference(params, x, top_k=1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-5)
+
+    def test_capacity_drops_tokens(self):
+        """With a tiny capacity, some tokens get zero expert output (the
+        residual carries them) — but nothing crashes and shapes hold."""
+        from deeplearning4j_tpu.parallel.expert_parallel import moe_reference
+
+        params, x = self._setup()
+        y = moe_reference(params, x, top_k=1, capacity_factor=0.1)
+        assert y.shape == x.shape
+        # at least one token must have been dropped (zero row)
+        flat = np.asarray(y).reshape(-1, y.shape[-1])
+        assert (np.abs(flat).sum(-1) == 0).any()
+
+    def test_load_balancing_loss_positive(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            load_balancing_loss,
+        )
+
+        params, x = self._setup()
+        aux = load_balancing_loss(x, params["Wg"])
+        # E * sum f_e P_e >= 1 (equality at perfect balance)
+        assert float(aux) >= 1.0 - 1e-6
+
+    def test_experts_not_divisible_raises(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import moe_apply
+
+        params, x = self._setup(n_experts=6)
+        mesh = _mesh(EXPERT_AXIS)
+        with pytest.raises(ValueError):
+            moe_apply(params, x, mesh)
+
+    def test_expert_param_placement(self):
+        from deeplearning4j_tpu.parallel.expert_parallel import (
+            shard_moe_params,
+        )
+
+        params, _ = self._setup()
+        mesh = _mesh(EXPERT_AXIS)
+        sp = shard_moe_params(params, mesh)
+        assert sp["W1"].addressable_shards[0].data.shape == (2, 16, 32)

@@ -1,0 +1,85 @@
+"""Flash-attention pallas kernel == dense attention (interpret mode on CPU).
+
+Same strategy as tests/test_pallas.py for the LSTM kernel: the kernel runs
+under interpret=True on the CPU mesh and must reproduce the dense XLA
+attention bit-for-bit-ish (f32 accumulation in both paths)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.pallas_attention import (
+    dense_attention,
+    flash_attention,
+    flash_fits,
+)
+
+
+def _qkv(n=2, t=256, h=2, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    return [
+        jnp.asarray(rng.standard_normal((n, t, h, d)), dtype)
+        for _ in range(3)
+    ]
+
+
+def _dense_nthd(q, k, v, causal):
+    return dense_attention(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    ref = _dense_nthd(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_multiple_k_blocks():
+    q, k, v = _qkv(t=512, d=32)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _dense_nthd(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_bf16_io():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _dense_nthd(q, k, v, True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2)
+
+
+def test_flash_gradients_match_dense():
+    q, k, v = _qkv(t=128)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_nthd(q, k, v, True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   err_msg=f"grad d{name}")
+
+
+def test_fits_gate():
+    assert flash_fits(1024, 64)
+    assert not flash_fits(1000, 64)       # not a block multiple
+    assert not flash_fits(65536, 128)     # k/v would blow VMEM
+
+
+def test_attention_auto_dense_fallback():
+    """Off-TPU (pallas disabled) attention_auto must take the dense path and
+    still be correct."""
+    from deeplearning4j_tpu.ops.pallas_attention import attention_auto
+
+    q, k, v = _qkv(t=64)  # 64 not a block multiple -> dense even if enabled
+    out = attention_auto(q, k, v, causal=True)
+    ref = _dense_nthd(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

@@ -1,0 +1,194 @@
+"""Transformer LM flagship: correctness, sharded == serial, MoE, generation.
+
+The sharded-vs-serial equivalence tests mirror the reference's
+distributed==serial strategy (SURVEY.md section 4) on the virtual 8-device
+CPU mesh: the SAME train step jitted (a) unsharded on one device and
+(b) GSPMD-sharded over a data x model mesh must produce the same loss curve.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    forward,
+    init_params,
+    make_train_step,
+    init_opt_state,
+    shard_params,
+)
+from deeplearning4j_tpu.parallel.mesh import device_mesh
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=50, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                max_len=16, learning_rate=1e-3, seed=0)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _batch(cfg, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, (n, cfg.max_len + 1))
+    return jnp.asarray(toks[:, :-1], jnp.int32), jnp.asarray(toks[:, 1:], jnp.int32)
+
+
+class TestForward:
+    def test_shapes_and_causality(self):
+        cfg = _cfg()
+        params = init_params(cfg)
+        x, _ = _batch(cfg)
+        logits, aux = forward(params, x, cfg)
+        assert logits.shape == (4, cfg.max_len, cfg.vocab_size)
+        assert float(aux) == 0.0  # dense model: no aux loss
+        # causality: changing a future token must not change past logits
+        x2 = x.at[:, -1].set((x[:, -1] + 1) % cfg.vocab_size)
+        logits2, _ = forward(params, x2, cfg)
+        np.testing.assert_allclose(np.asarray(logits[:, :-1]),
+                                   np.asarray(logits2[:, :-1]), atol=1e-5)
+
+    def test_initial_loss_near_log_vocab(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        from deeplearning4j_tpu.models.transformer import loss_fn
+
+        loss = float(loss_fn(lm.params, x, y, cfg))
+        assert abs(loss - np.log(cfg.vocab_size)) < 0.5
+
+
+class TestTraining:
+    def test_loss_decreases(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        first = float(lm.fit(x, y))
+        for _ in range(20):
+            last = float(lm.fit(x, y))
+        assert last < first
+
+    def test_sharded_matches_serial(self):
+        cfg = _cfg()
+        x, y = _batch(cfg, n=8)
+        serial = TransformerLM(cfg)
+        mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
+        sharded = TransformerLM(cfg, mesh=mesh)
+        for i in range(3):
+            ls = float(serial.fit(x, y))
+            lm_ = float(sharded.fit(x, y))
+            assert abs(ls - lm_) < 1e-3 * max(1.0, abs(ls)), (i, ls, lm_)
+
+    def test_param_placement(self):
+        cfg = _cfg()
+        mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
+        params = shard_params(init_params(cfg), cfg, mesh)
+        # column-parallel Wq shards its output dim over the 4-way model axis
+        shard = params["blocks"]["Wq"].addressable_shards[0]
+        assert shard.data.shape == (cfg.n_layers, 32, 32 // 4)
+
+
+class TestMoE:
+    def test_moe_trains_and_matches_serial(self):
+        cfg = _cfg(moe_experts=4, d_ff=32)
+        x, y = _batch(cfg, n=8)
+        serial = TransformerLM(cfg)
+        mesh = device_mesh(shape=(2, 2, 2),
+                           axis_names=("data", "model", "expert"))
+        sharded = TransformerLM(cfg, mesh=mesh)
+        for _ in range(2):
+            ls = float(serial.fit(x, y))
+            le = float(sharded.fit(x, y))
+            assert abs(ls - le) < 1e-3 * max(1.0, abs(ls))
+
+    def test_moe_aux_loss_nonzero(self):
+        cfg = _cfg(moe_experts=4, d_ff=32)
+        params = init_params(cfg)
+        x, _ = _batch(cfg)
+        _, aux = forward(params, x, cfg)
+        assert float(aux) > 0.0
+
+
+class TestRingForward:
+    def test_matches_dense_forward(self):
+        from deeplearning4j_tpu.models.transformer import ring_forward
+        from jax.sharding import Mesh
+
+        cfg = _cfg(max_len=32)
+        params = init_params(cfg)
+        x, _ = _batch(cfg)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        ring = ring_forward(params, x, cfg, mesh)
+        dense, _ = forward(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                                   atol=2e-4)
+
+
+class TestGeneration:
+    def test_generate_shapes_and_determinism(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out1 = lm.generate(prompt, n_new=5, seed=7)
+        out2 = lm.generate(prompt, n_new=5, seed=7)
+        assert out1.shape == (1, 5)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        assert int(out1.max()) < cfg.vocab_size
+        # the jitted sampler is cached per n_new, not rebuilt per call
+        assert len(lm._gen_cache) == 1
+
+    def test_greedy_first_token_matches_forward_argmax(self):
+        """Position correctness: with near-zero temperature the first
+        sampled token must be the argmax of the forward logits at the
+        prompt's true last position (a left-padded window would break
+        this by shifting position embeddings)."""
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+        out = lm.generate(prompt, n_new=1, temperature=1e-8, seed=0)
+        expect = int(jnp.argmax(lm.logits(prompt)[0, -1]))
+        assert int(out[0, 0]) == expect
+
+    def test_n_new_too_large_raises(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        import pytest
+
+        with pytest.raises(ValueError):
+            lm.generate(jnp.asarray([[1]], jnp.int32), n_new=cfg.max_len)
+
+
+class TestCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        lm.fit(x, y)
+        p = str(tmp_path / "lm.zip")
+        lm.save(p)
+        lm2 = TransformerLM.load(p)
+        np.testing.assert_allclose(
+            np.asarray(lm.logits(x)), np.asarray(lm2.logits(x)), atol=1e-6)
+        # dispatch through the generic ModelSerializer.restore
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        lm3 = ModelSerializer.restore(p)
+        assert isinstance(lm3, TransformerLM)
+        # training resumes identically (opt state round-trips)
+        l2 = float(lm2.fit(x, y))
+        l1 = float(lm.fit(x, y))
+        assert abs(l1 - l2) < 1e-6
+
+
+class TestMixedPrecision:
+    def test_bf16_policy_trains(self):
+        cfg = _cfg(dtype_policy="performance")
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        first = float(lm.fit(x, y))
+        for _ in range(10):
+            last = float(lm.fit(x, y))
+        assert np.isfinite(last) and last < first
+        # master params stay f32
+        assert lm.params["blocks"]["Wq"].dtype == jnp.float32
