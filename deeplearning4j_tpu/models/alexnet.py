@@ -46,7 +46,7 @@ def alexnet_conf(
     if final < 1:
         raise ValueError(
             f"input_size {input_size} too small for the AlexNet stack "
-            f"(pool5 output would be {final}x{final}; minimum input is 63)")
+            f"(pool5 output would be {final}x{final}; minimum input is 67)")
     b = (
         NeuralNetConfiguration.builder()
         .seed(seed)

@@ -18,8 +18,10 @@ shards between chips while each chip's local block product can run through
 this kernel.
 
 Scope & fallback policy (mirrors ops/pallas_kernels.py):
-  - forward only; backward is jax autodiff through the dense reference via
-    custom_vjp recompute (same gradients, fwd at kernel speed);
+  - pallas forward kernel + blocked XLA backward: the fwd saves each row's
+    log-sum-exp, and the custom_vjp recomputes probabilities K-block by
+    K-block (lax.scan), so neither pass ever materializes the [T, T]
+    score matrix;
   - causal and full attention; no padding mask (masked batches fall back);
   - engages when pallas is enabled (ops.pallas_kernels.pallas_enabled) and
     the k/v rows fit VMEM (flash_fits); else dense XLA attention;
@@ -49,10 +51,12 @@ def flash_fits(t: int, d: int) -> bool:
             and 2 * t * d <= _KV_BUDGET_FLOATS)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, scale: float,
-                  block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
+                  scale: float, block_k: int):
     """One q block vs all k/v blocks of one (batch*head) row.
-    q_ref/o_ref: [1, Bq, D]; k_ref/v_ref: [1, T, D]."""
+    q_ref/o_ref: [1, Bq, D]; k_ref/v_ref: [1, T, D]; lse_ref: [1, Bq]
+    (log-sum-exp of each row's scores — the residual the blocked backward
+    needs to recompute softmax probabilities without the running max)."""
     q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
     bq, d = q.shape
     t = k_ref.shape[1]
@@ -90,12 +94,29 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool, scale: float,
     else:
         n_blocks = t // block_k
     m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, a0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse block is [1, 8, Bq]: Mosaic requires the last two block dims be
+    # (8, 128)-aligned, so the scalar-per-row lse is broadcast across an
+    # 8-sublane dim the caller slices back off
+    lse_ref[0] = jnp.broadcast_to(
+        (m_safe_final(m) + jnp.log(l_safe))[None, :], (8, l.shape[0]))
+
+
+def m_safe_final(m):
+    """-inf running max (row saw no visible key) -> 0 so lse stays finite."""
+    return jnp.where(jnp.isfinite(m), m, 0.0)
 
 
 def _flash_raw(q, k, v, *, causal: bool, interpret: bool):
-    """q,k,v: [B, T, D] (B = batch*heads) -> [B, T, D]."""
+    """q,k,v: [B, T, D] (B = batch*heads) -> (out [B, T, D], lse [B, 8, T])."""
     b, t, d = q.shape
+    if t % _BLOCK_Q != 0 or t % _BLOCK_K != 0:
+        # without this guard tail rows would silently come back unwritten
+        # (NaN) — the grid and key loop both floor-divide by the block size
+        raise ValueError(
+            f"flash attention needs T divisible by {max(_BLOCK_Q, _BLOCK_K)}; "
+            f"got T={t} (use attention_auto for automatic dense fallback)")
     scale = 1.0 / (d ** 0.5)
     grid = (b, t // _BLOCK_Q)
     return pl.pallas_call(
@@ -107,8 +128,14 @@ def _flash_raw(q, k, v, *, causal: bool, interpret: bool):
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, _BLOCK_Q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK_Q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 8, _BLOCK_Q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, 8, t), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 
@@ -127,18 +154,56 @@ def _dense_reference(q, k, v, *, causal: bool):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, interpret):
-    return _flash_raw(q, k, v, causal=causal, interpret=interpret)
+    return _flash_raw(q, k, v, causal=causal, interpret=interpret)[0]
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_raw(q, k, v, causal=causal, interpret=interpret), (q, k, v)
+    o, lse = _flash_raw(q, k, v, causal=causal, interpret=interpret)
+    return o, (q, k, v, o, lse[:, 0, :])  # drop the sublane-padding dim
 
 
 def _flash_bwd(causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense_reference(q, k, v, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    """Blocked flash backward in plain XLA: softmax probabilities are
+    recomputed per K-block from the saved log-sum-exp, so peak memory is
+    O(T * block_k) per (batch*head) — never the [T, T] score matrix the
+    dense autodiff would materialize (which OOMs at large batch*T).
+
+    Standard flash-attention backward identities:
+      D_i  = sum_d dO_id O_id
+      P_ij = exp(S_ij - lse_i)
+      dV_j = P^T dO;  dP = dO V^T;  dS = P * (dP - D);  dQ += dS K;
+      dK_j = dS^T Q.
+    """
+    q, k, v, o, lse = res
+    b, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    f32 = lambda a: a.astype(jnp.float32)
+    q32, k32, v32 = f32(q), f32(k), f32(v)
+    g32 = f32(g)
+    Dvec = (g32 * f32(o)).sum(-1)                      # [B, T]
+    nb = t // _BLOCK_K
+    qi = jnp.arange(t)
+
+    def block(dq, j):
+        ks = lax.dynamic_slice_in_dim(k32, j * _BLOCK_K, _BLOCK_K, 1)
+        vs = lax.dynamic_slice_in_dim(v32, j * _BLOCK_K, _BLOCK_K, 1)
+        s = jnp.einsum("bqd,bkd->bqk", q32, ks) * scale
+        if causal:
+            ki = j * _BLOCK_K + jnp.arange(_BLOCK_K)
+            s = jnp.where((qi[:, None] >= ki[None, :])[None], s, -jnp.inf)
+        p = jnp.exp(s - lse[..., None])                # masked -> exp(-inf)=0
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, g32)
+        dp = jnp.einsum("bqd,bkd->bqk", g32, vs)
+        ds = p * (dp - Dvec[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, q32)
+        return dq, (dk_j, dv_j)
+
+    dq, (dks, dvs) = lax.scan(block, jnp.zeros_like(q32), jnp.arange(nb))
+    # scan stacks K-blocks on the leading axis: [nb, B, Bk, D] -> [B, T, D]
+    unstack = lambda a: a.transpose(1, 0, 2, 3).reshape(b, t, d)
+    return (dq.astype(q.dtype), unstack(dks).astype(k.dtype),
+            unstack(dvs).astype(v.dtype))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

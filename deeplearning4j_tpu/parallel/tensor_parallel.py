@@ -53,15 +53,16 @@ def init_tp_block_params(key, d_model: int, d_ff: int, num_heads: int,
                          dtype=jnp.float32) -> Params:
     """Standard transformer block params, laid out for column/row sharding.
 
-    Shapes are GLOBAL; `shard_tp_params` places them on the mesh. Xavier
-    init matches the framework's WeightInit.XAVIER semantics
-    (nn/weights — reference WeightInitUtil.java:93-123)."""
+    Shapes are GLOBAL; `shard_tp_params` places them on the mesh. Weight
+    init delegates to the framework's WeightInit.XAVIER
+    (nn/weights.init_weights — reference WeightInitUtil.java:93-123)."""
+    from deeplearning4j_tpu.nn.weights import init_weights
+
     ks = jax.random.split(key, 6)
 
     def xavier(k, shape):
-        fan_in, fan_out = shape[0], shape[-1]
-        scale = jnp.sqrt(2.0 / (fan_in + fan_out))
-        return (jax.random.normal(k, shape) * scale).astype(dtype)
+        return init_weights(k, shape, "xavier", shape[0], shape[-1],
+                            None).astype(dtype)
 
     return {
         "ln1_g": jnp.ones((d_model,), dtype),

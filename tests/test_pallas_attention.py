@@ -52,7 +52,9 @@ def test_flash_bf16_io():
 
 
 def test_flash_gradients_match_dense():
-    q, k, v = _qkv(t=128)
+    # t=256 = two K blocks: exercises the lax.scan accumulation, the
+    # cross-block causal masking, and the dK/dV unstack in _flash_bwd
+    q, k, v = _qkv(t=256, d=32)
 
     def loss_flash(q, k, v):
         return jnp.sum(
