@@ -564,35 +564,38 @@ print(json.dumps({
 """
 
 
+def _run_subprocess_json(args, timeout_s: int):
+    """Shared child-process scaffolding (north-star + per-leg isolation):
+    repo PYTHONPATH + persistent compile cache env, stderr tail on failure,
+    last-stdout-line JSON on success. Returns (parsed_or_None, err_or_None)."""
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + ":" + env.get("PYTHONPATH", "")
+    # the parent enables the persistent compile cache via jax.config (not
+    # inherited); pass it through the env so children skip re-compiles
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/.jax_compile_cache")
+    try:
+        out = subprocess.run(args, capture_output=True, text=True,
+                             timeout=timeout_s, env=env, cwd=repo_root)
+        if out.returncode != 0:
+            tail = (out.stderr or "").strip().splitlines()[-3:]
+            return None, f"exit {out.returncode}: {' | '.join(tail)}"
+        return json.loads(out.stdout.strip().splitlines()[-1]), None
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout_s}s (tunnel hang?)"
+    except Exception as e:  # noqa: BLE001
+        return None, f"{type(e).__name__}: {e}"
+
+
 def bench_north_star(steps=100, timeout=1800):
     """Runs in a SUBPROCESS: the remote-TPU tunnel can go stale inside a
     long-lived process (observed: the accel curve hangs forever in a remote
     call after the slow CPU leg) — a fresh process re-establishes the
     tunnel, and the timeout makes a hang a reported error instead of a
     wedged bench."""
-    repo_root = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)
-    env["PYTHONPATH"] = repo_root + ":" + env.get("PYTHONPATH", "")
-    # the parent enables the persistent compile cache via jax.config (not
-    # inherited); pass it through the env so the child skips re-compiles
-    env.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR", "/root/.jax_compile_cache"),
-    )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", _NORTH_STAR_SCRIPT, str(steps)],
-            capture_output=True, text=True, timeout=timeout, env=env,
-            cwd=repo_root,
-        )
-        if out.returncode != 0:
-            tail = (out.stderr or "").strip().splitlines()[-3:]
-            return {"error": f"exit {out.returncode}: {' | '.join(tail)}"}
-        return json.loads(out.stdout.strip().splitlines()[-1])
-    except subprocess.TimeoutExpired:
-        return {"error": f"timed out after {timeout}s (tunnel hang?)"}
-    except Exception as e:  # noqa: BLE001
-        return {"error": f"{type(e).__name__}: {e}"}
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _NORTH_STAR_SCRIPT, str(steps)], timeout)
+    return parsed if parsed is not None else {"error": err}
 
 
 def _probe_device(timeout_s: float = 180.0) -> Optional[str]:
@@ -623,10 +626,48 @@ def _probe_device(timeout_s: float = 180.0) -> Optional[str]:
                              "(remote-TPU tunnel down?)")
 
 
+def _run_isolated(name: str, quick: bool, timeout_s: int = 900,
+                  retries: int = 1):
+    """Run one bench leg as `bench.py --only=name` in a FRESH subprocess.
+
+    The axon remote-TPU tunnel goes stale inside long-lived processes
+    (observed: char_rnn wedged >20min with ~0 CPU mid-RPC after the lenet
+    legs finished; same failure mode the north-star harness already guards
+    against). A child process re-establishes the tunnel, the persistent
+    compile cache keeps re-compiles cheap, and a timeout turns a wedge
+    into a reported error + one retry instead of a dead bench run."""
+    args = [sys.executable, os.path.abspath(__file__), f"--only={name}"]
+    if quick:
+        args.append("--quick")
+    last_err = None
+    for attempt in range(retries + 1):
+        parsed, err = _run_subprocess_json(args, timeout_s)
+        if parsed is not None:
+            if name in parsed:
+                return parsed[name]
+            # child exited 0 without the leg's key — its own probe failed
+            # and it printed the accelerator-unavailable JSON; surface the
+            # REAL cause, not a KeyError
+            last_err = parsed.get("error", f"child output missing '{name}'")
+        else:
+            last_err = err
+        _log(f"{name} attempt {attempt}: {last_err}")
+    return {"error": last_err}
+
+
 def main():
     quick = "--quick" in sys.argv
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--only=")]
     probe_err = _probe_device()
+    if probe_err and not only:
+        # the tunnel can be transiently down; give it two more chances
+        # before declaring the whole bench dead
+        for wait in (60, 120):
+            _log(f"probe failed ({probe_err}); retrying in {wait}s")
+            time.sleep(wait)
+            probe_err = _probe_device()
+            if not probe_err:
+                break
     if probe_err:
         print(json.dumps({
             "metric": "lenet5_mnist_train_throughput", "value": 0.0,
@@ -643,7 +684,14 @@ def main():
         _log(f"start {name}")
         t0 = time.perf_counter()
         try:
-            extras[name] = fn(*a, **kw)
+            if only:
+                # child mode (--only=...): run in THIS process
+                extras[name] = fn(*a, **kw)
+            elif name in ("scaling_virtual8", "north_star"):
+                # already subprocess-isolated internally
+                extras[name] = fn(*a, **kw)
+            else:
+                extras[name] = _run_isolated(name, quick)
         except Exception as e:  # noqa: BLE001 — one broken bench must not kill the rest
             _log(f"FAILED {name}: {type(e).__name__}: {e}")
             extras[name] = {"error": f"{type(e).__name__}: {e}"}

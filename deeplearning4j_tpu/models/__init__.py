@@ -1,7 +1,13 @@
-"""Reference model zoo — the BASELINE.json workload configs.
+"""Model zoo — the BASELINE.json workload configs + era/beyond flagships.
 
-  lenet      — LeNet-5 MNIST (BASELINE configs[0])
-  char_rnn   — MLP + LSTM char-RNN (configs[1])
-  resnet     — ResNet-50 (configs[2], ComputationGraph-based)
-  word2vec   — skip-gram embeddings (configs[3], nlp package)
+  lenet       — LeNet-5 MNIST (BASELINE configs[0])
+  char_rnn    — MLP + LSTM char-RNN (configs[1])
+  resnet      — ResNet-50 (configs[2], ComputationGraph-based)
+  word2vec    — skip-gram embeddings (configs[3], nlp package)
+  alexnet     — AlexNet (dl4j-examples era big CNN)
+  vgg         — VGG-16
+  dbn         — stacked-RBM DBN + stacked denoising AEs (the reference
+                era's layerwise-pretraining flagships)
+  transformer — decoder LM, the multi-axis-parallel flagship (dp/tp/ep
+                GSPMD train step, ring/Ulysses seq parallel, flash attn)
 """

@@ -1,0 +1,104 @@
+"""Deep Belief Network builders — the reference era's flagship model family.
+
+DL4J 0.4's canonical examples are stacked-RBM DBNs with layerwise
+contrastive-divergence pretraining followed by supervised fine-tuning
+(reference: nn/layers/feedforward/rbm/RBM.java:101-137 contrastiveDivergence;
+MultiLayerNetwork.pretrain :165-213; the classic MNIST DBN example shape
+784-500-250-200-10). Here the same flow runs as jitted CD-k steps per layer
+(MultiLayerNetwork.pretrain) and one jitted train step for fine-tuning.
+
+Also provides the stacked denoising-autoencoder variant (reference
+nn/layers/feedforward/autoencoder/AutoEncoder.java — corruption + MSE
+reconstruction), the other pretraining-era stack.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from deeplearning4j_tpu.nn.conf import (
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers import RBM, AutoEncoder
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def dbn_conf(
+    n_in: int = 784,
+    hidden: Sequence[int] = (500, 250, 200),
+    num_classes: int = 10,
+    hidden_unit: str = "binary",
+    visible_unit: str = "binary",
+    k: int = 1,
+    seed: int = 123,
+    learning_rate: float = 0.1,
+    updater: str = "sgd",
+    activation: str = "sigmoid",
+):
+    """Stacked-RBM DBN: pretrain=True so fit() runs layerwise CD-k first
+    (when invoked via pretrain()), then backprop fine-tunes end-to-end."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .weight_init("xavier")
+        .list()
+        .pretrain(True)
+        .backprop(True)
+    )
+    sizes = [n_in, *hidden]
+    for i in range(len(hidden)):
+        b = b.layer(i, RBM(n_in=sizes[i], n_out=sizes[i + 1],
+                           hidden_unit=hidden_unit, visible_unit=visible_unit,
+                           k=k, activation=activation))
+    b = b.layer(len(hidden), OutputLayer(n_in=sizes[-1], n_out=num_classes,
+                                         activation="softmax",
+                                         loss_function="negativeloglikelihood"))
+    return b.build()
+
+
+def build_dbn(**kwargs) -> MultiLayerNetwork:
+    conf = dbn_conf(**kwargs)
+    n_in = conf.layers[0].n_in
+    return MultiLayerNetwork(conf).init(input_shape=(1, n_in))
+
+
+def stacked_autoencoder_conf(
+    n_in: int = 784,
+    hidden: Sequence[int] = (500, 250),
+    num_classes: int = 10,
+    corruption_level: float = 0.3,
+    seed: int = 123,
+    learning_rate: float = 0.1,
+    updater: str = "sgd",
+):
+    """Stacked denoising autoencoders + softmax head (the reference's
+    AutoEncoder layer: corruption + sigmoid reconstruction, pretrained
+    layerwise like the RBMs)."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .weight_init("xavier")
+        .list()
+        .pretrain(True)
+        .backprop(True)
+    )
+    sizes = [n_in, *hidden]
+    for i in range(len(hidden)):
+        b = b.layer(i, AutoEncoder(n_in=sizes[i], n_out=sizes[i + 1],
+                                   corruption_level=corruption_level,
+                                   activation="sigmoid"))
+    b = b.layer(len(hidden), OutputLayer(n_in=sizes[-1], n_out=num_classes,
+                                         activation="softmax",
+                                         loss_function="negativeloglikelihood"))
+    return b.build()
+
+
+def build_stacked_autoencoder(**kwargs) -> MultiLayerNetwork:
+    conf = stacked_autoencoder_conf(**kwargs)
+    n_in = conf.layers[0].n_in
+    return MultiLayerNetwork(conf).init(input_shape=(1, n_in))

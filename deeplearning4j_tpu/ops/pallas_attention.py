@@ -54,8 +54,9 @@ def flash_fits(t: int, d: int) -> bool:
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
                   scale: float, block_k: int):
     """One q block vs all k/v blocks of one (batch*head) row.
-    q_ref/o_ref: [1, Bq, D]; k_ref/v_ref: [1, T, D]; lse_ref: [1, Bq]
-    (log-sum-exp of each row's scores — the residual the blocked backward
+    q_ref/o_ref: [1, Bq, D]; k_ref/v_ref: [1, T, D]; lse_ref: [1, 8, Bq]
+    (log-sum-exp of each row's scores, broadcast over an 8-sublane padding
+    dim for Mosaic block alignment — the residual the blocked backward
     needs to recompute softmax probabilities without the running max)."""
     q = q_ref[0].astype(jnp.float32) * scale          # [Bq, D]
     bq, d = q.shape

@@ -14,6 +14,9 @@ Pieces:
   - `ring_attention(...)`: per-shard body (runs inside shard_map);
   - `ring_attention_sharded(...)`: user entry — builds the shard_map over a
     ('seq',) mesh axis and returns the full attention output;
+  - `ulysses_attention_sharded(...)`: the all-to-all alternative (swap the
+    sharded axis seq->heads, attend locally, swap back) for when heads
+    divide the mesh and per-device [T, T] blocks fit memory;
   - causal masking is exact across shards via global position indexing.
 
 Design notes (scaling-book recipe): the ring overlaps compute of block t
@@ -132,6 +135,50 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False):
     spec = P(None, SEQ_AXIS, None, None)
     fn = shard_map(
         partial(_ring_attention_body, causal=causal, t_local=t_local),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses: all-to-all sequence parallelism (the ring's sibling strategy)
+# ---------------------------------------------------------------------------
+
+
+def _ulysses_body(q, k, v, *, causal: bool, axis_name: str = SEQ_AXIS):
+    """Per-device body. q,k,v: [N, T_local, H, D] sequence shards.
+
+    Two all_to_alls instead of T/T_local ppermutes: swap the sharded axis
+    from sequence to heads (each device then holds ALL timesteps for H/p
+    heads), run plain dense attention locally, and swap back. Cheaper in
+    collective count than the ring when the full [T, T] block fits memory;
+    the ring wins when T is too long for any single device to hold T x T.
+    """
+    qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    att = multi_head_attention(qh, kh, vh, causal=causal)
+    return lax.all_to_all(att, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False):
+    """Exact full attention with the sequence dim sharded over mesh axis
+    'seq' via head<->sequence all_to_alls (DeepSpeed-Ulysses strategy).
+    q,k,v: [N, T, H, D]; T and H must both divide by the axis size."""
+    n_dev = mesh.shape[SEQ_AXIS]
+    t, h = q.shape[1], q.shape[2]
+    if t % n_dev != 0:
+        raise ValueError(f"sequence length {t} not divisible by {n_dev}")
+    if h % n_dev != 0:
+        raise ValueError(f"num heads {h} not divisible by {n_dev} devices "
+                         "(Ulysses shards heads; use ring attention instead)")
+    spec = P(None, SEQ_AXIS, None, None)
+    fn = shard_map(
+        partial(_ulysses_body, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -109,3 +109,48 @@ class TestAlexNetVgg:
         )
         # canonical VGG-16: ~138.36M params
         assert abs(net.num_params() - 138_357_544) < 1_000_000, net.num_params()
+
+
+class TestDbn:
+    def test_pretrain_then_finetune(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.models.dbn import build_dbn
+
+        net = build_dbn(n_in=20, hidden=(16, 12), num_classes=3,
+                        learning_rate=0.05)
+        rng = np.random.default_rng(0)
+        x = (rng.random((32, 20)) > 0.5).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net.pretrain(x, num_epochs=2)       # layerwise CD-k
+        first = net.fit(x, y)
+        for _ in range(15):
+            last = net.fit(x, y)
+        assert last < first
+
+    def test_stacked_autoencoder(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.models.dbn import build_stacked_autoencoder
+
+        net = build_stacked_autoencoder(n_in=20, hidden=(16,), num_classes=3,
+                                        learning_rate=0.05)
+        rng = np.random.default_rng(1)
+        x = rng.random((32, 20)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net.pretrain(x, num_epochs=1)
+        first = net.fit(x, y)
+        for _ in range(15):
+            last = net.fit(x, y)
+        assert last < first
+
+    def test_conf_roundtrip(self):
+        from deeplearning4j_tpu.models.dbn import dbn_conf
+        from deeplearning4j_tpu.nn.conf.multi_layer import (
+            MultiLayerConfiguration,
+        )
+
+        conf = dbn_conf(n_in=20, hidden=(16, 12), num_classes=3)
+        assert conf.pretrain is True
+        rt = MultiLayerConfiguration.from_json(conf.to_json())
+        assert rt.to_json() == conf.to_json()
