@@ -14,7 +14,7 @@ reconstruction), the other pretraining-era stack.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Callable, Sequence
 
 from deeplearning4j_tpu.nn.conf import (
     NeuralNetConfiguration,
@@ -22,6 +22,37 @@ from deeplearning4j_tpu.nn.conf import (
 )
 from deeplearning4j_tpu.nn.conf.layers import RBM, AutoEncoder
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _pretrain_stack_conf(
+    layer_factory: Callable[[int, int], object],
+    n_in: int,
+    hidden: Sequence[int],
+    num_classes: int,
+    seed: int,
+    learning_rate: float,
+    updater: str,
+):
+    """Shared scaffold for the two pretraining-era stacks: N pretrainable
+    layers from `layer_factory(n_in, n_out)` + a softmax head, with
+    pretrain=True so pretrain() runs layerwise before backprop fine-tune."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .weight_init("xavier")
+        .list()
+        .pretrain(True)
+        .backprop(True)
+    )
+    sizes = [n_in, *hidden]
+    for i in range(len(hidden)):
+        b = b.layer(i, layer_factory(sizes[i], sizes[i + 1]))
+    b = b.layer(len(hidden), OutputLayer(n_in=sizes[-1], n_out=num_classes,
+                                         activation="softmax",
+                                         loss_function="negativeloglikelihood"))
+    return b.build()
 
 
 def dbn_conf(
@@ -36,33 +67,13 @@ def dbn_conf(
     updater: str = "sgd",
     activation: str = "sigmoid",
 ):
-    """Stacked-RBM DBN: pretrain=True so fit() runs layerwise CD-k first
-    (when invoked via pretrain()), then backprop fine-tunes end-to-end."""
-    b = (
-        NeuralNetConfiguration.builder()
-        .seed(seed)
-        .learning_rate(learning_rate)
-        .updater(updater)
-        .weight_init("xavier")
-        .list()
-        .pretrain(True)
-        .backprop(True)
+    """Stacked-RBM DBN: CD-k pretraining then backprop fine-tune."""
+    return _pretrain_stack_conf(
+        lambda i, o: RBM(n_in=i, n_out=o, hidden_unit=hidden_unit,
+                         visible_unit=visible_unit, k=k,
+                         activation=activation),
+        n_in, hidden, num_classes, seed, learning_rate, updater,
     )
-    sizes = [n_in, *hidden]
-    for i in range(len(hidden)):
-        b = b.layer(i, RBM(n_in=sizes[i], n_out=sizes[i + 1],
-                           hidden_unit=hidden_unit, visible_unit=visible_unit,
-                           k=k, activation=activation))
-    b = b.layer(len(hidden), OutputLayer(n_in=sizes[-1], n_out=num_classes,
-                                         activation="softmax",
-                                         loss_function="negativeloglikelihood"))
-    return b.build()
-
-
-def build_dbn(**kwargs) -> MultiLayerNetwork:
-    conf = dbn_conf(**kwargs)
-    n_in = conf.layers[0].n_in
-    return MultiLayerNetwork(conf).init(input_shape=(1, n_in))
 
 
 def stacked_autoencoder_conf(
@@ -77,28 +88,21 @@ def stacked_autoencoder_conf(
     """Stacked denoising autoencoders + softmax head (the reference's
     AutoEncoder layer: corruption + sigmoid reconstruction, pretrained
     layerwise like the RBMs)."""
-    b = (
-        NeuralNetConfiguration.builder()
-        .seed(seed)
-        .learning_rate(learning_rate)
-        .updater(updater)
-        .weight_init("xavier")
-        .list()
-        .pretrain(True)
-        .backprop(True)
+    return _pretrain_stack_conf(
+        lambda i, o: AutoEncoder(n_in=i, n_out=o,
+                                 corruption_level=corruption_level,
+                                 activation="sigmoid"),
+        n_in, hidden, num_classes, seed, learning_rate, updater,
     )
-    sizes = [n_in, *hidden]
-    for i in range(len(hidden)):
-        b = b.layer(i, AutoEncoder(n_in=sizes[i], n_out=sizes[i + 1],
-                                   corruption_level=corruption_level,
-                                   activation="sigmoid"))
-    b = b.layer(len(hidden), OutputLayer(n_in=sizes[-1], n_out=num_classes,
-                                         activation="softmax",
-                                         loss_function="negativeloglikelihood"))
-    return b.build()
+
+
+def _build(conf) -> MultiLayerNetwork:
+    return MultiLayerNetwork(conf).init(input_shape=(1, conf.layers[0].n_in))
+
+
+def build_dbn(**kwargs) -> MultiLayerNetwork:
+    return _build(dbn_conf(**kwargs))
 
 
 def build_stacked_autoencoder(**kwargs) -> MultiLayerNetwork:
-    conf = stacked_autoencoder_conf(**kwargs)
-    n_in = conf.layers[0].n_in
-    return MultiLayerNetwork(conf).init(input_shape=(1, n_in))
+    return _build(stacked_autoencoder_conf(**kwargs))

@@ -321,14 +321,22 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
 
 
 def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-                 mesh: Mesh) -> jax.Array:
-    """Forward with attention computed as a RING over the 'seq' mesh axis
-    (parallel/sequence_parallel.py): exact full attention for sequences
-    sharded over devices. Used for long-context inference/eval."""
+                 mesh: Mesh, strategy: str = "ring") -> jax.Array:
+    """Forward with attention computed sequence-parallel over the 'seq'
+    mesh axis (parallel/sequence_parallel.py): exact full attention for
+    sequences sharded over devices. strategy='ring' rotates K/V shards via
+    ppermute (memory-optimal for very long T); strategy='ulysses' uses two
+    head<->sequence all_to_alls (fewer collectives; needs heads divisible
+    by the axis size). Used for long-context inference/eval."""
     from deeplearning4j_tpu.parallel.sequence_parallel import (
         ring_attention_sharded,
+        ulysses_attention_sharded,
     )
 
+    if strategy not in ("ring", "ulysses"):
+        raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
+    attend = (ring_attention_sharded if strategy == "ring"
+              else ulysses_attention_sharded)
     n, t = tokens.shape
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
     L = params["blocks"]["Wq"].shape[0]
@@ -339,7 +347,7 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         q = (x @ bp["Wq"]).reshape(n, t, cfg.n_heads, hd)
         k = (x @ bp["Wk"]).reshape(n, t, cfg.n_heads, hd)
         v = (x @ bp["Wv"]).reshape(n, t, cfg.n_heads, hd)
-        att = ring_attention_sharded(q, k, v, mesh, causal=True)
+        att = attend(q, k, v, mesh, causal=True)
         h = h + att.reshape(n, t, cfg.d_model) @ bp["Wo"]
         x = _ln(h, bp["ln2_g"], bp["ln2_b"])
         if cfg.moe_experts:
