@@ -10,8 +10,12 @@ peepholes, and the carried h/c stay resident in VMEM; only the per-step
 input projection streams in and the per-step output streams out.
 
 Scope & fallback policy:
-  - forward only; the backward pass is jax autodiff through the plain scan
-    (custom_vjp recomputes — same gradients, fwd at kernel speed);
+  - pallas kernels for BOTH directions: the forward emits the cell-state
+    sequence as a residual and a reverse-time kernel consumes it (gates
+    recomputed from xproj + h_prev; U and the dh/dc carry VMEM-resident
+    across the reverse sweep; dU/peephole grads accumulated in scratch).
+    Shapes whose backward blocks exceed VMEM (lstm_bwd_fits) fall back to
+    jax autodiff through the plain scan;
   - mask-free path (padded/masked sequences fall back to the scan);
   - DEFAULT ON for TPU (disable with DL4J_TPU_PALLAS=0). Measured on a
     v5e chip with a sound completion fence (benchmarks/
@@ -83,10 +87,13 @@ def _time_chunk(t: int, n: int, four_h: int) -> int:
 
 def lstm_scan_fits(n: int, h: int, t: int = 32) -> bool:
     """VMEM guard for the ACTUAL block sizes the kernel uses: a ch-timestep
-    xproj block (ch*n*4h, double-buffered) + output block (ch*n*h, ditto),
-    U, h/c scratch + io."""
+    xproj block (ch*n*4h, double-buffered) + hs output block (ch*n*h,
+    ditto) + the cs residual block the TRAINING forward also streams
+    (ch*n*h, ditto — counted always, conservatively: the primal can't know
+    whether autodiff will ask for it), U, h/c scratch + io."""
     ch = _time_chunk(t, n, 4 * h)
-    need = h * 4 * h + 4 * n * h + 2 * ch * n * 4 * h + 2 * ch * n * h
+    need = (h * 4 * h + 4 * n * h + 2 * ch * n * 4 * h
+            + 2 * (2 * ch * n * h))
     return need <= _VMEM_BUDGET_FLOATS
 
 
@@ -95,71 +102,91 @@ def lstm_scan_fits(n: int, h: int, t: int = 32) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _lstm_kernel(xproj_ref, u_ref, p_ref, h0_ref, c0_ref, hs_ref, hf_ref,
-                 cf_ref, h_scr, c_scr):
+def _make_lstm_kernel(emit_cs: bool):
     """Grid = (T,), sequential. Time-major layout: block t sees
     xproj[t, :, :] and writes hs[t, :, :] — the block's trailing two dims
     are then (N, 4H)/(N, H), satisfying the TPU (8, 128) tiling rule.
-    h/c live in VMEM scratch across iterations."""
-    t = pl.program_id(0)
-    n_t = pl.num_programs(0)
+    h/c live in VMEM scratch across iterations. With emit_cs the cell-state
+    sequence is emitted as a residual for the backward kernel (it recomputes
+    gates from xproj + h_prev but needs c_prev/c exactly, and re-running
+    the whole forward recurrence in reverse would serialize twice); the
+    no-grad primal uses the emit_cs=False variant so inference never pays
+    the extra T*N*H HBM write (pallas outputs cannot be DCE'd)."""
 
-    @pl.when(t == 0)
-    def _():
-        h_scr[:] = h0_ref[:]
-        c_scr[:] = c0_ref[:]
+    def kernel(xproj_ref, u_ref, p_ref, h0_ref, c0_ref, hs_ref, *rest):
+        if emit_cs:
+            cs_ref, hf_ref, cf_ref, h_scr, c_scr = rest
+        else:
+            hf_ref, cf_ref, h_scr, c_scr = rest
+        t = pl.program_id(0)
+        n_t = pl.num_programs(0)
 
-    n_out = h_scr.shape[-1]
-    chunk = xproj_ref.shape[0]
-    u = u_ref[:]
-    pi = p_ref[0, :]
-    pf = p_ref[1, :]
-    po = p_ref[2, :]
+        @pl.when(t == 0)
+        def _():
+            h_scr[:] = h0_ref[:]
+            c_scr[:] = c0_ref[:]
 
-    def body(k, carry):
-        h_prev, c_prev = carry
-        # z: [N, 4H] = xproj_t + h_prev @ U  (MXU)
-        z = xproj_ref[k, :, :] + jnp.dot(
-            h_prev, u, preferred_element_type=jnp.float32
-        )
-        zi = z[:, 0 * n_out : 1 * n_out]
-        zf = z[:, 1 * n_out : 2 * n_out]
-        zo = z[:, 2 * n_out : 3 * n_out]
-        zg = z[:, 3 * n_out : 4 * n_out]
-        i = jax.nn.sigmoid(zi + pi * c_prev)
-        f = jax.nn.sigmoid(zf + pf * c_prev)
-        g = jnp.tanh(zg)
-        c = f * c_prev + i * g
-        o = jax.nn.sigmoid(zo + po * c)
-        h = o * jnp.tanh(c)
-        hs_ref[k, :, :] = h
-        return h, c
+        n_out = h_scr.shape[-1]
+        chunk = xproj_ref.shape[0]
+        u = u_ref[:]
+        pi = p_ref[0, :]
+        pf = p_ref[1, :]
+        po = p_ref[2, :]
 
-    h, c = jax.lax.fori_loop(0, chunk, body, (h_scr[:], c_scr[:]))
-    h_scr[:] = h
-    c_scr[:] = c
+        def body(k, carry):
+            h_prev, c_prev = carry
+            # z: [N, 4H] = xproj_t + h_prev @ U  (MXU)
+            z = xproj_ref[k, :, :] + jnp.dot(
+                h_prev, u, preferred_element_type=jnp.float32
+            )
+            zi = z[:, 0 * n_out : 1 * n_out]
+            zf = z[:, 1 * n_out : 2 * n_out]
+            zo = z[:, 2 * n_out : 3 * n_out]
+            zg = z[:, 3 * n_out : 4 * n_out]
+            i = jax.nn.sigmoid(zi + pi * c_prev)
+            f = jax.nn.sigmoid(zf + pf * c_prev)
+            g = jnp.tanh(zg)
+            c = f * c_prev + i * g
+            o = jax.nn.sigmoid(zo + po * c)
+            h = o * jnp.tanh(c)
+            hs_ref[k, :, :] = h
+            if emit_cs:
+                cs_ref[k, :, :] = c
+            return h, c
 
-    @pl.when(t == n_t - 1)
-    def _():
-        hf_ref[:] = h
-        cf_ref[:] = c
+        h, c = jax.lax.fori_loop(0, chunk, body, (h_scr[:], c_scr[:]))
+        h_scr[:] = h
+        c_scr[:] = c
+
+        @pl.when(t == n_t - 1)
+        def _():
+            hf_ref[:] = h
+            cf_ref[:] = c
+
+    return kernel
 
 
-def _lstm_pallas_fwd_raw(xproj, u, p, h0, c0, *, interpret: bool):
+def _lstm_pallas_fwd_raw(xproj, u, p, h0, c0, *, interpret: bool,
+                         emit_cs: bool = False):
     """xproj: [N, T, 4H] (input projection + bias, precomputed);
-    returns (hs [N,T,H], h_f, c_f)."""
+    returns (hs [N,T,H], cs_tm [T,N,H] residual or None, h_f, c_f)."""
     n, t, four_h = xproj.shape
     h_dim = four_h // 4
     ch = _time_chunk(t, n, four_h)
     grid = (t // ch,)
-    out_shape = (
-        jax.ShapeDtypeStruct((t, n, h_dim), jnp.float32),
-        jax.ShapeDtypeStruct((n, h_dim), jnp.float32),
-        jax.ShapeDtypeStruct((n, h_dim), jnp.float32),
-    )
+    blk_seq = pl.BlockSpec((ch, n, h_dim), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+    blk_nh = pl.BlockSpec((n, h_dim), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    seq_shape = jax.ShapeDtypeStruct((t, n, h_dim), jnp.float32)
+    nh_shape = jax.ShapeDtypeStruct((n, h_dim), jnp.float32)
+    out_shape = ((seq_shape,) + ((seq_shape,) if emit_cs else ())
+                 + (nh_shape, nh_shape))
+    out_specs = ((blk_seq,) + ((blk_seq,) if emit_cs else ())
+                 + (blk_nh, blk_nh))
     xproj_tm = jnp.swapaxes(xproj, 0, 1)  # time-major [T, N, 4H]
-    hs_tm, h_f, c_f = pl.pallas_call(
-        _lstm_kernel,
+    outs = pl.pallas_call(
+        _make_lstm_kernel(emit_cs),
         grid=grid,
         in_specs=[
             pl.BlockSpec((ch, n, four_h), lambda i: (i, 0, 0),
@@ -168,19 +195,10 @@ def _lstm_pallas_fwd_raw(xproj, u, p, h0, c0, *, interpret: bool):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((3, h_dim), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
+            blk_nh,
+            blk_nh,
         ],
-        out_specs=(
-            pl.BlockSpec((ch, n, h_dim), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((n, h_dim), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ),
+        out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((n, h_dim), jnp.float32),
@@ -189,7 +207,11 @@ def _lstm_pallas_fwd_raw(xproj, u, p, h0, c0, *, interpret: bool):
         interpret=interpret,
     )(xproj_tm.astype(jnp.float32), u.astype(jnp.float32),
       p.astype(jnp.float32), h0.astype(jnp.float32), c0.astype(jnp.float32))
-    return jnp.swapaxes(hs_tm, 0, 1), h_f, c_f
+    if emit_cs:
+        hs_tm, cs_tm, h_f, c_f = outs
+    else:
+        (hs_tm, h_f, c_f), cs_tm = outs, None
+    return jnp.swapaxes(hs_tm, 0, 1), cs_tm, h_f, c_f
 
 
 def _lstm_scan_reference(xproj, u, p, h0, c0):
@@ -212,23 +234,185 @@ def _lstm_scan_reference(xproj, u, p, h0, c0):
     return jnp.swapaxes(hs, 0, 1), h_f, c_f
 
 
+# ---------------------------------------------------------------------------
+# Fused LSTM backward scan (reverse-time pallas kernel)
+# ---------------------------------------------------------------------------
+
+
+def _lstm_bwd_kernel(xproj_ref, hprev_ref, cprev_ref, cs_ref, u_ref, p_ref,
+                     dhs_ref, dhf_ref, dcf_ref,
+                     dxproj_ref, du_ref, dp_ref, dh0_ref, dc0_ref,
+                     dh_scr, dc_scr, du_scr, dp_scr):
+    """Reverse-time twin of _lstm_kernel. The grid runs 0..n_t-1 but the
+    index maps hand block i the (n_t-1-i)-th time chunk, so U and the
+    carried dh/dc stay VMEM-resident across the whole reverse sweep while
+    time blocks stream through. Gates are recomputed from xproj + h_prev
+    (cheaper than storing 4 gate planes); c_prev/c come from the saved
+    cell sequence. dU / peephole grads accumulate in VMEM scratch and are
+    written once at the final program."""
+    t = pl.program_id(0)
+    n_t = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        dh_scr[:] = dhf_ref[:]          # cotangent of the FINAL h
+        dc_scr[:] = dcf_ref[:]
+        du_scr[:] = jnp.zeros_like(du_scr)
+        dp_scr[:] = jnp.zeros_like(dp_scr)
+
+    chunk = xproj_ref.shape[0]
+    n_out = dh_scr.shape[-1]
+    u = u_ref[:]
+    pi = p_ref[0, :]
+    pf = p_ref[1, :]
+    po = p_ref[2, :]
+
+    def body(k, carry):
+        dh_c, dc_c, du_a, dpi_a, dpf_a, dpo_a = carry
+        kk = chunk - 1 - k              # reverse order inside the block
+        h_prev = hprev_ref[kk, :, :]
+        c_prev = cprev_ref[kk, :, :]
+        c = cs_ref[kk, :, :]
+        z = xproj_ref[kk, :, :] + jnp.dot(
+            h_prev, u, preferred_element_type=jnp.float32)
+        i = jax.nn.sigmoid(z[:, 0 * n_out:1 * n_out] + pi * c_prev)
+        f = jax.nn.sigmoid(z[:, 1 * n_out:2 * n_out] + pf * c_prev)
+        o = jax.nn.sigmoid(z[:, 2 * n_out:3 * n_out] + po * c)
+        g = jnp.tanh(z[:, 3 * n_out:4 * n_out])
+        tc = jnp.tanh(c)
+
+        dh = dhs_ref[kk, :, :] + dh_c
+        do = dh * tc
+        dzo = do * o * (1.0 - o)
+        dc = dh * o * (1.0 - tc * tc) + dc_c + dzo * po
+        dzi = dc * g * i * (1.0 - i)
+        dzg = dc * i * (1.0 - g * g)
+        dzf = dc * c_prev * f * (1.0 - f)
+        dz = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)
+        dxproj_ref[kk, :, :] = dz
+        du_a = du_a + jnp.dot(h_prev.T, dz,
+                              preferred_element_type=jnp.float32)
+        dpi_a = dpi_a + jnp.sum(dzi * c_prev, axis=0)
+        dpf_a = dpf_a + jnp.sum(dzf * c_prev, axis=0)
+        dpo_a = dpo_a + jnp.sum(dzo * c, axis=0)
+        dh_c = jnp.dot(dz, u.T, preferred_element_type=jnp.float32)
+        dc_c = dc * f + dzi * pi + dzf * pf
+        return dh_c, dc_c, du_a, dpi_a, dpf_a, dpo_a
+
+    zeros_h = jnp.zeros((n_out,), jnp.float32)
+    dh_c, dc_c, du_a, dpi_a, dpf_a, dpo_a = jax.lax.fori_loop(
+        0, chunk, body,
+        (dh_scr[:], dc_scr[:], jnp.zeros_like(du_scr[:]),
+         zeros_h, zeros_h, zeros_h),
+    )
+    dh_scr[:] = dh_c
+    dc_scr[:] = dc_c
+    du_scr[:] = du_scr[:] + du_a
+    dp_scr[0, :] = dp_scr[0, :] + dpi_a
+    dp_scr[1, :] = dp_scr[1, :] + dpf_a
+    dp_scr[2, :] = dp_scr[2, :] + dpo_a
+
+    @pl.when(t == n_t - 1)
+    def _():
+        du_ref[:] = du_scr[:]
+        dp_ref[:] = dp_scr[:]
+        dh0_ref[:] = dh_c
+        dc0_ref[:] = dc_c
+
+
+def lstm_bwd_fits(n: int, h: int, t: int = 32) -> bool:
+    """VMEM guard for the backward kernel: U + dU + dp scratch + the six
+    streamed time blocks (xproj, dxproj at 4H; hprev/cprev/cs/dhs at H),
+    double-buffered."""
+    ch = _time_chunk(t, n, 4 * h)
+    need = (2 * h * 4 * h + 6 * h              # U, dU scratch, dp
+            + 2 * (2 * ch * n * 4 * h)         # xproj + dxproj blocks
+            + 4 * (2 * ch * n * h)             # hprev/cprev/cs/dhs blocks
+            + 4 * n * h)                       # carries + dhf/dcf
+    return need <= _VMEM_BUDGET_FLOATS
+
+
+def _lstm_pallas_bwd_raw(xproj, u, p, h0, c0, cs_tm, hs, dhs, dh_f, dc_f,
+                         *, interpret: bool):
+    """All-pallas reverse pass. Returns (dxproj [N,T,4H], dU, dp, dh0, dc0)."""
+    n, t, four_h = xproj.shape
+    h_dim = four_h // 4
+    ch = _time_chunk(t, n, four_h)
+    n_blk = t // ch
+    xproj_tm = jnp.swapaxes(xproj, 0, 1).astype(jnp.float32)
+    hs_tm = jnp.swapaxes(hs, 0, 1).astype(jnp.float32)
+    dhs_tm = jnp.swapaxes(dhs, 0, 1).astype(jnp.float32)
+    # h_{t-1} / c_{t-1} streams: shift the saved sequences right by one
+    hprev_tm = jnp.concatenate([h0.astype(jnp.float32)[None], hs_tm[:-1]], 0)
+    cprev_tm = jnp.concatenate([c0.astype(jnp.float32)[None], cs_tm[:-1]], 0)
+
+    rev = lambda i: (n_blk - 1 - i, 0, 0)
+    fixed2 = lambda i: (0, 0)
+    blk_t = lambda w: pl.BlockSpec((ch, n, w), rev, memory_space=pltpu.VMEM)
+    blk_nh = pl.BlockSpec((n, h_dim), fixed2, memory_space=pltpu.VMEM)
+
+    out_shape = (
+        jax.ShapeDtypeStruct((t, n, four_h), jnp.float32),   # dxproj
+        jax.ShapeDtypeStruct((h_dim, four_h), jnp.float32),  # dU
+        jax.ShapeDtypeStruct((3, h_dim), jnp.float32),       # dp
+        jax.ShapeDtypeStruct((n, h_dim), jnp.float32),       # dh0
+        jax.ShapeDtypeStruct((n, h_dim), jnp.float32),       # dc0
+    )
+    dxproj_tm, du, dp, dh0, dc0 = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=(n_blk,),
+        in_specs=[
+            blk_t(four_h),                                    # xproj
+            blk_t(h_dim), blk_t(h_dim), blk_t(h_dim),         # hprev/cprev/cs
+            pl.BlockSpec((h_dim, four_h), fixed2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, h_dim), fixed2, memory_space=pltpu.VMEM),
+            blk_t(h_dim),                                     # dhs
+            blk_nh, blk_nh,                                   # dh_f, dc_f
+        ],
+        out_specs=(
+            blk_t(four_h),
+            pl.BlockSpec((h_dim, four_h), fixed2, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, h_dim), fixed2, memory_space=pltpu.VMEM),
+            blk_nh, blk_nh,
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((n, h_dim), jnp.float32),
+            pltpu.VMEM((n, h_dim), jnp.float32),
+            pltpu.VMEM((h_dim, four_h), jnp.float32),
+            pltpu.VMEM((3, h_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(xproj_tm, hprev_tm, cprev_tm, cs_tm, u.astype(jnp.float32),
+      p.astype(jnp.float32), dhs_tm, dh_f.astype(jnp.float32),
+      dc_f.astype(jnp.float32))
+    return jnp.swapaxes(dxproj_tm, 0, 1), du, dp, dh0, dc0
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def lstm_pallas_scan(xproj, u, p, h0, c0, interpret=False):
-    """Fused LSTM forward scan: pallas kernel forward, scan-autodiff
-    backward. Gate order in the 4H axis is [i, f, o, g], identical to
+    """Fused LSTM scan: pallas kernels for BOTH directions (reverse-time
+    backward kernel when the shape fits VMEM, scan-autodiff fallback
+    otherwise). Gate order in the 4H axis is [i, f, o, g], identical to
     recurrent._lstm_step's z-split, so params are shared untouched."""
-    hs, h_f, c_f = _lstm_pallas_fwd_raw(xproj, u, p, h0, c0,
-                                        interpret=interpret)
+    hs, _, h_f, c_f = _lstm_pallas_fwd_raw(xproj, u, p, h0, c0,
+                                           interpret=interpret)
     return hs, h_f, c_f
 
 
 def _lstm_fwd(xproj, u, p, h0, c0, interpret):
-    out = lstm_pallas_scan(xproj, u, p, h0, c0, interpret)
-    return out, (xproj, u, p, h0, c0)
+    hs, cs_tm, h_f, c_f = _lstm_pallas_fwd_raw(
+        xproj, u, p, h0, c0, interpret=interpret, emit_cs=True)
+    return (hs, h_f, c_f), (xproj, u, p, h0, c0, cs_tm, hs)
 
 
 def _lstm_bwd(interpret, res, grads):
-    xproj, u, p, h0, c0 = res
+    xproj, u, p, h0, c0, cs_tm, hs = res
+    dhs, dh_f, dc_f = grads
+    n, t, four_h = xproj.shape
+    if lstm_bwd_fits(n, four_h // 4, t):
+        return _lstm_pallas_bwd_raw(xproj, u, p, h0, c0, cs_tm, hs,
+                                    dhs, dh_f, dc_f, interpret=interpret)
     _, vjp = jax.vjp(
         lambda *args: _lstm_scan_reference(*args), xproj, u, p, h0, c0
     )

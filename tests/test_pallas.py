@@ -88,3 +88,39 @@ class TestLayerIntegration:
         np.testing.assert_allclose(ys_pal, ys_scan, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(st_pal["h"], st_scan["h"], rtol=1e-5,
                                    atol=1e-6)
+
+    def test_gradients_multiblock_reverse(self):
+        """t=64 -> several time chunks: exercises the reversed index maps,
+        the VMEM dU/dp accumulation across grid steps, and the dh/dc carry
+        across block boundaries in the backward kernel."""
+        xproj, u, p, h0, c0 = make_inputs(t=64, seed=9)
+
+        def loss_kernel(xp, uu, pp, hh, cc):
+            hs, hf, cf = pk.lstm_pallas_scan(xp, uu, pp, hh, cc, True)
+            return jnp.sum(hs**2) + jnp.sum(hf * cf)
+
+        def loss_ref(xp, uu, pp, hh, cc):
+            hs, hf, cf = pk._lstm_scan_reference(xp, uu, pp, hh, cc)
+            return jnp.sum(hs**2) + jnp.sum(hf * cf)
+
+        gk = jax.grad(loss_kernel, argnums=(0, 1, 2, 3, 4))(xproj, u, p, h0, c0)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(xproj, u, p, h0, c0)
+        for a, b, name in zip(gk, gr, ("xproj", "u", "p", "h0", "c0")):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4,
+                                       err_msg=f"grad d{name}")
+
+    def test_bwd_unfit_falls_back_to_scan_vjp(self, monkeypatch):
+        xproj, u, p, h0, c0 = make_inputs(seed=4)
+        monkeypatch.setattr(pk, "lstm_bwd_fits", lambda *a, **k: False)
+
+        def loss(xp):
+            hs, hf, cf = pk.lstm_pallas_scan(xp, u, p, h0, c0, True)
+            return jnp.sum(hs**2)
+
+        def loss_ref(xp):
+            hs, hf, cf = pk._lstm_scan_reference(xp, u, p, h0, c0)
+            return jnp.sum(hs**2)
+
+        np.testing.assert_allclose(jax.grad(loss)(xproj),
+                                   jax.grad(loss_ref)(xproj),
+                                   rtol=1e-4, atol=1e-5)
