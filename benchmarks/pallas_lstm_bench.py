@@ -15,7 +15,11 @@ helper loading (ConvolutionLayer.java:64-70).
 """
 
 import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -85,16 +89,50 @@ def main():
                  "pallas_error": f"DIVERGENCE vs scan: max_abs_dev={max_dev}"}
             )
             continue
-        results["cases"].append(
-            {
-                "n": n, "t": t, "h": h,
-                "scan_ms": round(scan_ms, 3),
-                "pallas_ms": round(pallas_ms, 3),
-                "pallas_interpret_mode": interpret,
-                "scan_speedup_over_pallas": round(pallas_ms / scan_ms, 2),
-                "max_abs_dev_vs_scan": max_dev,
-            }
-        )
+        case = {
+            "n": n, "t": t, "h": h,
+            "scan_ms": round(scan_ms, 3),
+            "pallas_ms": round(pallas_ms, 3),
+            "pallas_interpret_mode": interpret,
+            "scan_speedup_over_pallas": round(pallas_ms / scan_ms, 2),
+            "max_abs_dev_vs_scan": max_dev,
+        }
+
+        # fwd+bwd (the training step shape): reverse-time pallas backward
+        # kernel vs scan autodiff. Interpret mode (CPU smoke) only runs the
+        # smallest case — interpreted reverse sweeps on the big shapes take
+        # tens of minutes and the unit tests already cover correctness.
+        if interpret and (n, t, h) != (32, 128, 128):
+            results["cases"].append(case)
+            continue
+
+        def grad_of(fn):
+            return jax.jit(jax.grad(
+                lambda xp, uu: jnp.sum(fn(xp, uu, p, h0, c0)[0] ** 2),
+                argnums=(0, 1)))
+
+        scan_g = grad_of(lambda *a: pk._lstm_scan_reference(*a))
+        pallas_g = grad_of(lambda *a: pk.lstm_pallas_scan(*a, interpret))
+        try:
+            scan_bwd_ms = _bench(scan_g, (xproj, u),
+                                 steps=3 if interpret else 30) * 1e3
+            pallas_bwd_ms = _bench(pallas_g, (xproj, u),
+                                   steps=3 if interpret else 30) * 1e3
+            g_dev = max(
+                float(jnp.max(jnp.abs(a - b)))
+                for a, b in zip(pallas_g(xproj, u), scan_g(xproj, u))
+            )
+            case.update({
+                "scan_fwdbwd_ms": round(scan_bwd_ms, 3),
+                "pallas_fwdbwd_ms": round(pallas_bwd_ms, 3),
+                "bwd_kernel_engaged": pk.lstm_bwd_fits(n, h, t),
+                "scan_speedup_over_pallas_fwdbwd":
+                    round(pallas_bwd_ms / scan_bwd_ms, 2),
+                "max_grad_dev_vs_scan": g_dev,
+            })
+        except Exception as e:  # noqa: BLE001
+            case["bwd_error"] = f"{type(e).__name__}: {e}"
+        results["cases"].append(case)
     if not is_tpu:
         results["verdict"] = (
             "CPU run (interpret mode) — timing not meaningful; see TPU run"

@@ -88,12 +88,14 @@ def _time_chunk(t: int, n: int, four_h: int) -> int:
 def lstm_scan_fits(n: int, h: int, t: int = 32) -> bool:
     """VMEM guard for the ACTUAL block sizes the kernel uses: a ch-timestep
     xproj block (ch*n*4h, double-buffered) + hs output block (ch*n*h,
-    ditto) + the cs residual block the TRAINING forward also streams
-    (ch*n*h, ditto — counted always, conservatively: the primal can't know
-    whether autodiff will ask for it), U, h/c scratch + io."""
+    ditto), U, h/c scratch + io. The cs residual block is counted only for
+    shapes whose BACKWARD kernel fits (lstm_bwd_fits) — only those
+    forwards emit it (_lstm_fwd); everything else backward-falls-back to
+    scan autodiff and the forward stays residual-free."""
     ch = _time_chunk(t, n, 4 * h)
-    need = (h * 4 * h + 4 * n * h + 2 * ch * n * 4 * h
-            + 2 * (2 * ch * n * h))
+    need = h * 4 * h + 4 * n * h + 2 * ch * n * 4 * h + 2 * ch * n * h
+    if lstm_bwd_fits(n, h, t):
+        need += 2 * ch * n * h  # the double-buffered cs residual block
     return need <= _VMEM_BUDGET_FLOATS
 
 
@@ -401,16 +403,20 @@ def lstm_pallas_scan(xproj, u, p, h0, c0, interpret=False):
 
 
 def _lstm_fwd(xproj, u, p, h0, c0, interpret):
+    # emit the cell-state residual ONLY when the backward kernel will
+    # consume it; otherwise the backward is scan-autodiff (which recomputes
+    # its own forward) and the residual would be a pure HBM-write waste
+    n, t, four_h = xproj.shape
+    emit = lstm_bwd_fits(n, four_h // 4, t)
     hs, cs_tm, h_f, c_f = _lstm_pallas_fwd_raw(
-        xproj, u, p, h0, c0, interpret=interpret, emit_cs=True)
+        xproj, u, p, h0, c0, interpret=interpret, emit_cs=emit)
     return (hs, h_f, c_f), (xproj, u, p, h0, c0, cs_tm, hs)
 
 
 def _lstm_bwd(interpret, res, grads):
     xproj, u, p, h0, c0, cs_tm, hs = res
     dhs, dh_f, dc_f = grads
-    n, t, four_h = xproj.shape
-    if lstm_bwd_fits(n, four_h // 4, t):
+    if cs_tm is not None:
         return _lstm_pallas_bwd_raw(xproj, u, p, h0, c0, cs_tm, hs,
                                     dhs, dh_f, dc_f, interpret=interpret)
     _, vjp = jax.vjp(
