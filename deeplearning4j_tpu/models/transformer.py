@@ -383,6 +383,24 @@ class TransformerLM:
         self._step = make_train_step(self._run_cfg, mesh)
         self._gen_cache: Dict[int, Any] = {}
 
+    @classmethod
+    def from_state(cls, cfg: TransformerConfig, params: Params,
+                   opt: Optional[Params] = None,
+                   mesh: Optional[Mesh] = None) -> "TransformerLM":
+        """Build an LM around EXISTING state without running (or paying
+        for) a random init — the restore path for checkpoints whose params
+        are already materialized/sharded (utils/sharded_checkpoint.py)."""
+        lm = cls.__new__(cls)
+        lm.cfg = cfg
+        lm._run_cfg = (dataclasses.replace(cfg, use_flash=False)
+                       if mesh is not None else cfg)
+        lm.mesh = mesh
+        lm.params = params
+        lm.opt = opt if opt is not None else init_opt_state(params)
+        lm._step = make_train_step(lm._run_cfg, mesh)
+        lm._gen_cache = {}
+        return lm
+
     def fit(self, tokens: jax.Array, targets: jax.Array) -> jax.Array:
         self.params, self.opt, loss = self._step(
             self.params, self.opt, tokens, targets)
@@ -390,6 +408,11 @@ class TransformerLM:
 
     def logits(self, tokens: jax.Array) -> jax.Array:
         return forward(self.params, tokens, self._run_cfg)[0]
+
+    def output(self, tokens) -> jax.Array:
+        """Container-compatible inference surface (MultiLayerNetwork.output
+        / streaming ModelServer.predict): token ids in, logits out."""
+        return self.logits(jnp.asarray(tokens).astype(jnp.int32))
 
     def save(self, path: str) -> None:
         """Checkpoint in the framework's ModelSerializer zip layout

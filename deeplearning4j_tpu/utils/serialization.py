@@ -131,7 +131,23 @@ class ModelSerializer:
 
     @staticmethod
     def restore(path: str, load_updater: bool = True):
-        """Restore either container, dispatching on the saved model_class."""
+        """Restore any checkpoint, dispatching on the saved model_class.
+        Accepts both the zip format and the sharded orbax DIRECTORY format
+        (utils/sharded_checkpoint.py)."""
+        import os
+
+        if os.path.isdir(path):
+            with open(os.path.join(path, "metadata.json")) as f:
+                meta = json.load(f)
+            if meta.get("model_class") == "TransformerLM":
+                from deeplearning4j_tpu.utils.sharded_checkpoint import (
+                    restore_lm,
+                )
+
+                return restore_lm(path, load_updater=load_updater)
+            raise ValueError(
+                f"unknown sharded checkpoint model_class "
+                f"{meta.get('model_class')!r} at {path}")
         with zipfile.ZipFile(path, "r") as z:
             meta = json.loads(z.read("metadata.json").decode())
         if meta.get("model_class") == "ComputationGraph":

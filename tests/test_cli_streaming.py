@@ -181,6 +181,28 @@ class TestModelServer:
         finally:
             s.stop()
 
+    def test_serve_transformer_checkpoint(self, tmp_path):
+        """The generic restore dispatch serves TransformerLM checkpoints
+        through the same /predict surface (token ids in, logits out)."""
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        lm = TransformerLM(TransformerConfig(
+            vocab_size=20, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            max_len=8))
+        p = str(tmp_path / "lm.zip")
+        lm.save(p)
+        s = ModelServer(model_path=p, port=0).start()
+        try:
+            out = self._post(s, {"record": [1, 2, 3, 4]})
+            direct = np.asarray(lm.output(np.array([[1, 2, 3, 4]])))[0]
+            np.testing.assert_allclose(np.asarray(out["output"]),
+                                       direct, rtol=1e-4)
+        finally:
+            s.stop()
+
 
 class TestStreamingPipeline:
     def test_stream_training(self):

@@ -1,0 +1,122 @@
+"""Sharded orbax checkpoint/resume on the virtual 8-device mesh: save a
+dp x model sharded transformer, restore into the same shardings, resume
+training identically — the multi-chip ModelSerializer role."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+)
+from deeplearning4j_tpu.parallel.mesh import device_mesh
+from deeplearning4j_tpu.utils.sharded_checkpoint import (
+    restore_lm,
+    restore_pytree,
+    save_lm,
+    save_pytree,
+)
+
+
+def _cfg():
+    return TransformerConfig(vocab_size=40, d_model=32, n_layers=2,
+                             n_heads=4, d_ff=64, max_len=16,
+                             learning_rate=1e-3)
+
+
+def _batch(cfg, n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    t = rng.integers(0, cfg.vocab_size, (n, cfg.max_len + 1))
+    return (jnp.asarray(t[:, :-1], jnp.int32),
+            jnp.asarray(t[:, 1:], jnp.int32))
+
+
+class TestPytreeRoundtrip:
+    def test_plain_pytree(self, tmp_path):
+        tree = {"a": jnp.arange(12.0).reshape(3, 4),
+                "b": {"c": jnp.ones((5,), jnp.int32)}}
+        save_pytree(str(tmp_path / "t"), tree)
+        back = restore_pytree(str(tmp_path / "t"), tree)
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                       np.asarray(y)),
+            tree, back)
+
+    def test_sharded_restores_with_sharding(self, tmp_path):
+        mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
+        cfg = _cfg()
+        lm = TransformerLM(cfg, mesh=mesh)
+        save_pytree(str(tmp_path / "p"), lm.params)
+        back = restore_pytree(str(tmp_path / "p"), lm.params)
+        wq = back["blocks"]["Wq"]
+        assert wq.sharding == lm.params["blocks"]["Wq"].sharding
+        assert wq.addressable_shards[0].data.shape == (2, 32, 32 // 4)
+
+
+class TestLmCheckpoint:
+    def test_save_restore_resume_identical(self, tmp_path):
+        cfg = _cfg()
+        x, y = _batch(cfg)
+        mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
+        lm = TransformerLM(cfg, mesh=mesh)
+        lm.fit(x, y)
+        save_lm(str(tmp_path / "ckpt"), lm)
+
+        lm2 = restore_lm(str(tmp_path / "ckpt"), mesh=mesh)
+        np.testing.assert_allclose(np.asarray(lm.output(x)),
+                                   np.asarray(lm2.output(x)), atol=1e-6)
+        # resuming training produces the same loss (opt state round-trips)
+        l1 = float(lm.fit(x, y))
+        l2 = float(lm2.fit(x, y))
+        assert abs(l1 - l2) < 1e-6
+
+    def test_restore_single_device_from_sharded(self, tmp_path):
+        """A checkpoint written from a mesh restores on one device (the
+        cross-topology resume the flat-zip format can't do without a
+        gather)."""
+        cfg = _cfg()
+        x, y = _batch(cfg)
+        mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
+        lm = TransformerLM(cfg, mesh=mesh)
+        lm.fit(x, y)
+        save_lm(str(tmp_path / "ckpt"), lm)
+        lm_single = restore_lm(str(tmp_path / "ckpt"), mesh=None)
+        np.testing.assert_allclose(np.asarray(lm.output(x)),
+                                   np.asarray(lm_single.output(x)), atol=1e-5)
+
+    def test_overwrite_is_atomic_and_repeatable(self, tmp_path):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        p = str(tmp_path / "ckpt")
+        save_lm(p, lm)
+        lm.fit(x, y)
+        save_lm(p, lm)  # second save overwrites in place
+        lm2 = restore_lm(p)
+        np.testing.assert_allclose(np.asarray(lm.output(x)),
+                                   np.asarray(lm2.output(x)), atol=1e-6)
+
+    def test_generic_restore_dispatches_directory(self, tmp_path):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        p = str(tmp_path / "ckpt")
+        save_lm(p, lm)
+        lm2 = ModelSerializer.restore(p)
+        assert isinstance(lm2, TransformerLM)
+        x, _ = _batch(cfg)
+        np.testing.assert_allclose(np.asarray(lm.output(x)),
+                                   np.asarray(lm2.output(x)), atol=1e-6)
+
+    def test_weights_only_restore(self, tmp_path):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        lm.fit(x, y)
+        save_lm(str(tmp_path / "ckpt"), lm)
+        lm2 = restore_lm(str(tmp_path / "ckpt"), load_updater=False)
+        assert int(lm2.opt["t"]) == 0  # fresh optimizer
+        np.testing.assert_allclose(np.asarray(lm.output(x)),
+                                   np.asarray(lm2.output(x)), atol=1e-6)
