@@ -60,6 +60,16 @@ class TransformerConfig:
     moe_aux_coef: float = 1e-2
     dtype_policy: str = "strict"  # "strict" f32 | "performance" bf16 compute
     learning_rate: float = 3e-4
+    # LR schedule (reference LearningRatePolicy role): linear warmup over
+    # warmup_steps, then optional "cosine" decay to 0 at total_steps
+    warmup_steps: int = 0
+    lr_schedule: str = "none"     # "none" | "cosine"
+    total_steps: int = 0
+    # gradient accumulation: microbatches per optimizer step (exact
+    # full-batch equivalence at 1/A activation memory; dense FFN only —
+    # MoE capacity/aux statistics are batch-dependent, so make_train_step
+    # rejects the combination)
+    accum_steps: int = 1
     seed: int = 0
     # flash-attention pallas kernel (ops/pallas_attention.py) on the
     # single-device path; the GSPMD-sharded path always uses dense XLA
@@ -288,16 +298,68 @@ def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
     return new, {"m": m, "v": v, "t": t}
 
 
+def _scheduled_lr(cfg: TransformerConfig, t):
+    """LR at integer step t (1-based): optional linear warmup then optional
+    cosine decay to zero over cfg.total_steps (standard LM schedule; the
+    reference's LR-policy role — optimize/updaters.py — for the flagship)."""
+    tf = t.astype(jnp.float32)
+    lr = jnp.asarray(cfg.learning_rate, jnp.float32)
+    if cfg.warmup_steps > 0:
+        lr = lr * jnp.minimum(1.0, tf / cfg.warmup_steps)
+    if cfg.lr_schedule == "cosine" and cfg.total_steps > 0:
+        frac = jnp.clip((tf - cfg.warmup_steps)
+                        / max(1, cfg.total_steps - cfg.warmup_steps), 0.0, 1.0)
+        lr = lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    return lr
+
+
 def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     """Returns step(params, opt, tokens, targets) -> (params, opt, loss),
     jitted. With a mesh: params carry Megatron/MoE shardings, the batch is
     sharded over 'data', and GSPMD derives the full DP x TP x EP collective
     schedule (gradient all-reduce over 'data'; the two per-block psums over
-    'model'; expert all-to-alls over 'expert')."""
+    'model'; expert all-to-alls over 'expert').
+
+    cfg.accum_steps > 1 = gradient accumulation: the batch is split into A
+    microbatches whose gradients are averaged in a lax.scan before ONE
+    optimizer update — for dense configs numerically the full-batch step
+    (the loss is a batch mean, so mean-of-microbatch-grads == full-batch
+    grad) at 1/A the activation memory. MoE configs are rejected: expert
+    capacity and the load-balance aux loss are batch-statistic dependent,
+    so microbatching would silently change the objective."""
+    accum_steps = cfg.accum_steps
+    if accum_steps > 1 and cfg.moe_experts:
+        raise ValueError(
+            "gradient accumulation with MoE is not full-batch equivalent "
+            "(per-microbatch expert capacity + aux-loss statistics); use "
+            "accum_steps=1 or a dense FFN config")
 
     def step(params, opt, tokens, targets):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, cfg)
-        params, opt = _adam_update(params, grads, opt, cfg.learning_rate)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets, cfg)
+        else:
+            b = tokens.shape[0]
+            if b % accum_steps != 0:
+                raise ValueError(
+                    f"batch {b} not divisible by accum_steps {accum_steps}")
+            mb = b // accum_steps
+            xs = tokens.reshape(accum_steps, mb, *tokens.shape[1:])
+            ys = targets.reshape(accum_steps, mb, *targets.shape[1:])
+
+            def micro(carry, xy):
+                loss_a, grads_a = carry
+                loss_i, grads_i = jax.value_and_grad(loss_fn)(
+                    params, xy[0], xy[1], cfg)
+                grads_a = jax.tree_util.tree_map(
+                    lambda a, g: a + g / accum_steps, grads_a, grads_i)
+                return (loss_a + loss_i / accum_steps, grads_a), None
+
+            zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (loss, grads), _ = lax.scan(
+                micro, (jnp.zeros((), jnp.float32), zero), (xs, ys))
+        lr = _scheduled_lr(cfg, opt["t"] + 1)
+        params, opt = _adam_update(params, grads, opt, lr)
         return params, opt, loss
 
     if mesh is None:

@@ -130,10 +130,12 @@ class ModelSerializer:
         return net
 
     @staticmethod
-    def restore(path: str, load_updater: bool = True):
+    def restore(path: str, load_updater: bool = True, mesh=None):
         """Restore any checkpoint, dispatching on the saved model_class.
         Accepts both the zip format and the sharded orbax DIRECTORY format
-        (utils/sharded_checkpoint.py)."""
+        (utils/sharded_checkpoint.py). `mesh` (directory format only)
+        restores the state directly into its mesh shardings — without it a
+        mesh-scale checkpoint would materialize unsharded on one device."""
         import os
 
         if os.path.isdir(path):
@@ -144,7 +146,8 @@ class ModelSerializer:
                     restore_lm,
                 )
 
-                return restore_lm(path, load_updater=load_updater)
+                return restore_lm(path, mesh=mesh,
+                                  load_updater=load_updater)
             raise ValueError(
                 f"unknown sharded checkpoint model_class "
                 f"{meta.get('model_class')!r} at {path}")

@@ -3,79 +3,120 @@
 The reference's checkpoint story is a single-host ZIP of flat params
 (util/ModelSerializer.java:70-110); at mesh scale that design forces a
 full gather onto one host. This module keeps the reference's three-part
-semantic (configuration + coefficients + updater) but stores the
-params/opt pytrees through orbax's PyTree checkpointing, which writes each
-device's shards in parallel and restores them directly INTO a target
-sharding — no host-side gather on save, no host-side scatter on load.
+semantic (configuration + coefficients + updater) but stores the state
+pytree through orbax's PyTree checkpointing, which writes each device's
+shards in parallel and restores them directly INTO a target sharding —
+no host-side gather on save, no host-side scatter on load.
+
+Crash safety: each save writes a fresh VERSION directory and then commits
+it by atomically replacing a small pointer file (`<path>.current`) — the
+only mutation a reader can observe is the pointer flip, so a preemption at
+ANY instant leaves either the previous checkpoint or the new one fully
+intact, never a mix and never nothing. Params and optimizer state travel
+in ONE payload per version, so they can never come from different
+generations. Superseded versions are pruned after the commit.
 
 Works for any pytree-of-arrays model state; `save_lm` / `restore_lm` wrap
-it for the transformer flagship (models/transformer.py).
+it for the transformer flagship (models/transformer.py), and
+`ModelSerializer.restore(path, mesh=...)` dispatches checkpoint
+directories here.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
 import os
+import shutil
 from typing import Any, Optional
 
 import jax
 
+_CKPTR = None
+
 
 def _checkpointer():
-    import orbax.checkpoint as ocp
+    """One long-lived StandardCheckpointer (it owns async worker threads —
+    constructing one per call would leak them over a checkpointing loop)."""
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
 
-    return ocp.StandardCheckpointer()
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def _pointer_file(path: str) -> str:
+    return path + ".current"
+
+
+def _resolve(path: str) -> str:
+    """Directory holding the committed checkpoint data for `path`."""
+    ptr = _pointer_file(path)
+    if os.path.isfile(ptr):
+        with open(ptr) as f:
+            return os.path.join(os.path.dirname(path), f.read().strip())
+    return path  # pre-pointer layout / externally produced checkpoint
 
 
 def save_pytree(path: str, tree: Any) -> None:
     """Write a pytree of (possibly sharded) arrays. Each device's shards
-    stream out in parallel; replicated leaves are written once. Overwrites
-    an existing checkpoint at `path` ATOMICALLY: the new checkpoint is
-    fully written to a temp sibling first, then swapped in — a crash
-    mid-save (the preemption this module exists to survive) can never
-    destroy the previous checkpoint."""
-    import shutil
-
+    stream out in parallel; replicated leaves are written once. Overwrite
+    is crash-safe: the new version is fully written before the atomic
+    pointer-file flip commits it (see module docstring)."""
     path = os.path.abspath(path)
-    tmp = f"{path}.tmp-{os.getpid()}"
-    if os.path.isdir(tmp):
-        shutil.rmtree(tmp)
+    versions = sorted(glob.glob(path + ".v*"))
+    n = 1 + max((int(v.rsplit(".v", 1)[1]) for v in versions
+                 if v.rsplit(".v", 1)[1].isdigit()), default=0)
+    vdir = f"{path}.v{n}"
     ckptr = _checkpointer()
-    ckptr.save(tmp, tree)
+    ckptr.save(vdir, tree)
     ckptr.wait_until_finished()
+    # atomic commit: os.replace of the pointer FILE
+    ptr_tmp = f"{_pointer_file(path)}.tmp-{os.getpid()}"
+    with open(ptr_tmp, "w") as f:
+        f.write(os.path.basename(vdir))
+    os.replace(ptr_tmp, _pointer_file(path))
+    # prune superseded versions (and any legacy un-versioned dir)
+    for old in versions:
+        shutil.rmtree(old, ignore_errors=True)
     if os.path.isdir(path):
-        old = f"{path}.old-{os.getpid()}"
-        os.rename(path, old)
-        os.rename(tmp, path)
-        shutil.rmtree(old)
-    else:
-        os.rename(tmp, path)
+        shutil.rmtree(path, ignore_errors=True)
 
 
 def restore_pytree(path: str, like: Any) -> Any:
     """Restore INTO the structure/shardings of `like`: every leaf comes
     back with `like`'s dtype, shape, and (if sharded) placement — the
-    resume path for a mesh-sharded model without any host gather."""
+    resume path for a mesh-sharded model without any host gather. `like`
+    may be concrete arrays OR abstract ShapeDtypeStructs."""
     targets = jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
         if hasattr(a, "sharding") else a,
         like,
     )
-    return _checkpointer().restore(os.path.abspath(path), targets)
+    return _checkpointer().restore(_resolve(os.path.abspath(path)), targets)
 
 
 def save_lm(dirpath: str, lm) -> None:
-    """Transformer flagship checkpoint: config JSON + sharded params +
-    sharded opt state (the reference's 3-part layout as a directory)."""
+    """Transformer flagship checkpoint: config JSON + ONE atomic payload
+    holding params AND optimizer state (so a restored checkpoint can never
+    mix generations)."""
     dirpath = os.path.abspath(dirpath)
     os.makedirs(dirpath, exist_ok=True)
-    with open(os.path.join(dirpath, "configuration.json"), "w") as f:
-        json.dump(dataclasses.asdict(lm.cfg), f)
-    with open(os.path.join(dirpath, "metadata.json"), "w") as f:
-        json.dump({"model_class": "TransformerLM", "format": "orbax-dir"}, f)
-    save_pytree(os.path.join(dirpath, "coefficients"), lm.params)
-    save_pytree(os.path.join(dirpath, "updater"), lm.opt)
+
+    def write_json(name, obj):
+        tmp = os.path.join(dirpath, f".{name}.tmp-{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, os.path.join(dirpath, name))
+
+    write_json("configuration.json", dataclasses.asdict(lm.cfg))
+    write_json("metadata.json",
+               {"model_class": "TransformerLM", "format": "orbax-dir"})
+    save_pytree(os.path.join(dirpath, "state"), {
+        "params": lm.params, "opt": lm.opt,
+    })
 
 
 def restore_lm(dirpath: str, mesh: Optional[Any] = None,
@@ -104,25 +145,22 @@ def restore_lm(dirpath: str, mesh: Optional[Any] = None,
 
     def mk():
         p = init_params(cfg)
-        return p, init_opt_state(p)
+        return {"params": p, "opt": init_opt_state(p)}
 
-    abs_params, abs_opt = jax.eval_shape(mk)
+    abstract = jax.eval_shape(mk)
     if mesh is not None:
         specs = param_specs(cfg)
         attach = lambda a, s: jax.ShapeDtypeStruct(
             a.shape, a.dtype, sharding=NamedSharding(mesh, s))
         is_sds = lambda x: isinstance(x, jax.ShapeDtypeStruct)
-        abs_params = jax.tree_util.tree_map(attach, abs_params, specs,
-                                            is_leaf=is_sds)
-        abs_opt = {
-            "m": jax.tree_util.tree_map(attach, abs_opt["m"], specs,
-                                        is_leaf=is_sds),
-            "v": jax.tree_util.tree_map(attach, abs_opt["v"], specs,
-                                        is_leaf=is_sds),
-            "t": abs_opt["t"],
+        tmap = lambda t: jax.tree_util.tree_map(attach, t, specs,
+                                                is_leaf=is_sds)
+        abstract = {
+            "params": tmap(abstract["params"]),
+            "opt": {"m": tmap(abstract["opt"]["m"]),
+                    "v": tmap(abstract["opt"]["v"]),
+                    "t": abstract["opt"]["t"]},
         }
-    params = restore_pytree(os.path.join(dirpath, "coefficients"), abs_params)
-    opt = None
-    if load_updater and os.path.isdir(os.path.join(dirpath, "updater")):
-        opt = restore_pytree(os.path.join(dirpath, "updater"), abs_opt)
-    return TransformerLM.from_state(cfg, params, opt, mesh=mesh)
+    state = restore_pytree(os.path.join(dirpath, "state"), abstract)
+    opt = state["opt"] if load_updater else None
+    return TransformerLM.from_state(cfg, state["params"], opt, mesh=mesh)

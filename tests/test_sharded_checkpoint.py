@@ -120,3 +120,33 @@ class TestLmCheckpoint:
         assert int(lm2.opt["t"]) == 0  # fresh optimizer
         np.testing.assert_allclose(np.asarray(lm.output(x)),
                                    np.asarray(lm2.output(x)), atol=1e-6)
+
+
+class TestCrashSafety:
+    def test_pointer_commit_and_prune(self, tmp_path):
+        import os
+
+        tree = {"a": jnp.arange(6.0)}
+        p = str(tmp_path / "t")
+        save_pytree(p, tree)
+        save_pytree(p, {"a": jnp.arange(6.0) * 2})
+        assert os.path.isfile(p + ".current")
+        with open(p + ".current") as f:
+            assert f.read().strip() == "t.v2"
+        assert not os.path.isdir(p + ".v1")  # superseded version pruned
+        back = restore_pytree(p, tree)
+        np.testing.assert_allclose(np.asarray(back["a"]),
+                                   np.arange(6.0) * 2)
+
+    def test_uncommitted_version_is_invisible(self, tmp_path):
+        """A version directory without a pointer flip (the crash-mid-save
+        state) must not be picked up by restore."""
+        import os
+
+        tree = {"a": jnp.arange(4.0)}
+        p = str(tmp_path / "t")
+        save_pytree(p, tree)
+        # simulate a crashed later save: a newer version dir, no commit
+        os.makedirs(p + ".v99")
+        back = restore_pytree(p, tree)
+        np.testing.assert_allclose(np.asarray(back["a"]), np.arange(4.0))

@@ -192,3 +192,54 @@ class TestMixedPrecision:
         assert np.isfinite(last) and last < first
         # master params stay f32
         assert lm.params["blocks"]["Wq"].dtype == jnp.float32
+
+
+class TestAccumAndSchedule:
+    def test_accumulation_matches_full_batch(self):
+        cfg_full = _cfg()
+        cfg_acc = _cfg(accum_steps=4)
+        x, y = _batch(cfg_full, n=8)
+        full = TransformerLM(cfg_full)
+        acc = TransformerLM(cfg_acc)
+        for i in range(3):
+            lf = float(full.fit(x, y))
+            la = float(acc.fit(x, y))
+            assert abs(lf - la) < 1e-4 * max(1.0, abs(lf)), (i, lf, la)
+
+    def test_accum_not_dividing_batch_raises(self):
+        cfg = _cfg(accum_steps=3)
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg, n=8)
+        import pytest
+
+        with pytest.raises(ValueError):
+            lm.fit(x, y)
+
+    def test_warmup_cosine_schedule(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.transformer import _scheduled_lr
+
+        cfg = _cfg(warmup_steps=10, lr_schedule="cosine", total_steps=110)
+        lr0 = float(_scheduled_lr(cfg, jnp.asarray(1)))
+        lr_w = float(_scheduled_lr(cfg, jnp.asarray(10)))
+        lr_end = float(_scheduled_lr(cfg, jnp.asarray(110)))
+        assert abs(lr0 - cfg.learning_rate / 10) < 1e-9
+        assert abs(lr_w - cfg.learning_rate) < 1e-9
+        assert lr_end < 1e-6
+
+    def test_scheduled_training_runs(self):
+        cfg = _cfg(warmup_steps=3, lr_schedule="cosine", total_steps=30)
+        lm = TransformerLM(cfg)
+        x, y = _batch(cfg)
+        first = float(lm.fit(x, y))
+        for _ in range(10):
+            last = float(lm.fit(x, y))
+        assert np.isfinite(last) and last < first
+
+    def test_moe_accum_rejected(self):
+        import pytest
+
+        cfg = _cfg(accum_steps=2, moe_experts=4, d_ff=32)
+        with pytest.raises(ValueError):
+            TransformerLM(cfg)
