@@ -208,6 +208,22 @@ def _attention(q, k, v, n_heads, use_flash=False):
     return jnp.einsum("nhqk,nkhd->nqhd", p, v).reshape(n, t, d)
 
 
+def _dense_block_f32(bp, h, n_heads: int, attend=None):
+    """One dense transformer block in plain f32 (no flash, no casts) —
+    the block body shared by the sequence-parallel (ring_forward) and
+    pipeline-parallel (pipeline_forward) paths; forward() keeps its own
+    cast-aware variant for the mixed-precision/flash path. `attend`
+    overrides the attention op ((q, k, v) [N,T,F] -> [N,T,F]) so the ring/
+    Ulysses strategies plug in without copying the rest of the block."""
+    if attend is None:
+        attend = lambda q, k, v: _attention(q, k, v, n_heads)
+    x = _ln(h, bp["ln1_g"], bp["ln1_b"])
+    q, k, v = x @ bp["Wq"], x @ bp["Wk"], x @ bp["Wv"]
+    h = h + attend(q, k, v) @ bp["Wo"]
+    x = _ln(h, bp["ln2_g"], bp["ln2_b"])
+    return h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] + bp["b2"]
+
+
 def _moe_ffn(bp, h, cfg: TransformerConfig):
     """MoE FFN: routing + expert math shared with parallel/expert_parallel
     (called inline, not through its shard_map, so GSPMD shards the expert
@@ -333,6 +349,12 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
             "gradient accumulation with MoE is not full-batch equivalent "
             "(per-microbatch expert capacity + aux-loss statistics); use "
             "accum_steps=1 or a dense FFN config")
+    if cfg.lr_schedule not in ("none", "cosine"):
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
+                         "(know: none, cosine)")
+    if cfg.lr_schedule == "cosine" and cfg.total_steps <= 0:
+        raise ValueError("lr_schedule='cosine' needs total_steps > 0 "
+                         "(otherwise the decay is silently dropped)")
 
     def step(params, opt, tokens, targets):
         if accum_steps == 1:
@@ -397,26 +419,71 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
     if strategy not in ("ring", "ulysses"):
         raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
-    attend = (ring_attention_sharded if strategy == "ring"
-              else ulysses_attention_sharded)
+    sharded_att = (ring_attention_sharded if strategy == "ring"
+                   else ulysses_attention_sharded)
     n, t = tokens.shape
+    hd = cfg.d_model // cfg.n_heads
+
+    def attend(q, k, v):
+        split = lambda a: a.reshape(n, t, cfg.n_heads, hd)
+        out = sharded_att(split(q), split(k), split(v), mesh, causal=True)
+        return out.reshape(n, t, cfg.d_model)
+
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
     L = params["blocks"]["Wq"].shape[0]
-    hd = cfg.d_model // cfg.n_heads
     for i in range(L):
         bp = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
-        x = _ln(h, bp["ln1_g"], bp["ln1_b"])
-        q = (x @ bp["Wq"]).reshape(n, t, cfg.n_heads, hd)
-        k = (x @ bp["Wk"]).reshape(n, t, cfg.n_heads, hd)
-        v = (x @ bp["Wv"]).reshape(n, t, cfg.n_heads, hd)
-        att = attend(q, k, v, mesh, causal=True)
-        h = h + att.reshape(n, t, cfg.d_model) @ bp["Wo"]
-        x = _ln(h, bp["ln2_g"], bp["ln2_b"])
         if cfg.moe_experts:
+            x = _ln(h, bp["ln1_g"], bp["ln1_b"])
+            h = h + attend(x @ bp["Wq"], x @ bp["Wk"], x @ bp["Wv"]) \
+                @ bp["Wo"]
+            x = _ln(h, bp["ln2_g"], bp["ln2_b"])
             y, _ = _moe_ffn(bp, x, cfg)
             h = h + y
         else:
-            h = h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] + bp["b2"]
+            h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend)
+    h = _ln(h, params["lnf_g"], params["lnf_b"])
+    return h @ params["embed"].T
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel forward (depth sharded over the 'pipe' axis)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_forward(params: Params, tokens: jax.Array,
+                     cfg: TransformerConfig, mesh: Mesh, *,
+                     n_micro: int) -> jax.Array:
+    """Forward with the LAYER STACK sharded over the mesh's 'pipe' axis
+    (parallel/pipeline_parallel.py GPipe schedule): stage s holds layers
+    [s*L/S, (s+1)*L/S); microbatches flow through the ring via ppermute.
+    Embedding and the tied head run replicated outside the pipeline (they
+    are a small fraction of the params). Differentiable — jax.grad gives
+    the backward pipeline via the scan/ppermute transposes."""
+    from deeplearning4j_tpu.parallel.pipeline_parallel import pipeline_apply
+
+    n_stages = mesh.shape["pipe"]
+    L = cfg.n_layers
+    if L % n_stages != 0:
+        raise ValueError(f"n_layers {L} not divisible by {n_stages} stages")
+    if cfg.moe_experts:
+        raise NotImplementedError("pipeline_forward supports dense FFN blocks")
+    per = L // n_stages
+    # restack block leaves [L, ...] -> [S, per, ...] (stage-major)
+    stage_params = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_stages, per) + a.shape[1:]), params["blocks"])
+
+    def stage_fn(sp, h):
+        def block(h, bp):
+            return _dense_block_f32(bp, h, cfg.n_heads), None
+
+        h, _ = lax.scan(block, h, sp)
+        return h
+
+    n, t = tokens.shape
+    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
+    h = pipeline_apply(stage_params, h, mesh, stage_fn=stage_fn,
+                       n_micro=n_micro)
     h = _ln(h, params["lnf_g"], params["lnf_b"])
     return h @ params["embed"].T
 

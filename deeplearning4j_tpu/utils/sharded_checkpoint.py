@@ -60,19 +60,20 @@ def _resolve(path: str) -> str:
     return path  # pre-pointer layout / externally produced checkpoint
 
 
-def save_pytree(path: str, tree: Any) -> None:
-    """Write a pytree of (possibly sharded) arrays. Each device's shards
-    stream out in parallel; replicated leaves are written once. Overwrite
-    is crash-safe: the new version is fully written before the atomic
-    pointer-file flip commits it (see module docstring)."""
+def _save_version(path: str, items: dict) -> None:
+    """Write every named pytree in `items` into ONE fresh version directory
+    (vdir/<name> each), then commit the whole generation with a single
+    atomic pointer-file flip — all items are from the same save or none
+    are visible."""
     path = os.path.abspath(path)
     versions = sorted(glob.glob(path + ".v*"))
     n = 1 + max((int(v.rsplit(".v", 1)[1]) for v in versions
                  if v.rsplit(".v", 1)[1].isdigit()), default=0)
     vdir = f"{path}.v{n}"
     ckptr = _checkpointer()
-    ckptr.save(vdir, tree)
-    ckptr.wait_until_finished()
+    for name, tree in items.items():
+        ckptr.save(os.path.join(vdir, name), tree)
+        ckptr.wait_until_finished()
     # atomic commit: os.replace of the pointer FILE
     ptr_tmp = f"{_pointer_file(path)}.tmp-{os.getpid()}"
     with open(ptr_tmp, "w") as f:
@@ -85,17 +86,32 @@ def save_pytree(path: str, tree: Any) -> None:
         shutil.rmtree(path, ignore_errors=True)
 
 
-def restore_pytree(path: str, like: Any) -> Any:
-    """Restore INTO the structure/shardings of `like`: every leaf comes
-    back with `like`'s dtype, shape, and (if sharded) placement — the
-    resume path for a mesh-sharded model without any host gather. `like`
-    may be concrete arrays OR abstract ShapeDtypeStructs."""
-    targets = jax.tree_util.tree_map(
+def save_pytree(path: str, tree: Any) -> None:
+    """Write a pytree of (possibly sharded) arrays. Each device's shards
+    stream out in parallel; replicated leaves are written once. Overwrite
+    is crash-safe: the new version is fully written before the atomic
+    pointer-file flip commits it (see module docstring)."""
+    _save_version(path, {"item": tree})
+
+
+def _as_targets(like: Any) -> Any:
+    return jax.tree_util.tree_map(
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
         if hasattr(a, "sharding") else a,
         like,
     )
-    return _checkpointer().restore(_resolve(os.path.abspath(path)), targets)
+
+
+def restore_pytree(path: str, like: Any, item: str = "item") -> Any:
+    """Restore INTO the structure/shardings of `like`: every leaf comes
+    back with `like`'s dtype, shape, and (if sharded) placement — the
+    resume path for a mesh-sharded model without any host gather. `like`
+    may be concrete arrays OR abstract ShapeDtypeStructs."""
+    base = _resolve(os.path.abspath(path))
+    sub = os.path.join(base, item)
+    if os.path.isdir(sub):
+        base = sub  # versioned multi-item layout
+    return _checkpointer().restore(base, _as_targets(like))
 
 
 def save_lm(dirpath: str, lm) -> None:
@@ -114,9 +130,11 @@ def save_lm(dirpath: str, lm) -> None:
     write_json("configuration.json", dataclasses.asdict(lm.cfg))
     write_json("metadata.json",
                {"model_class": "TransformerLM", "format": "orbax-dir"})
-    save_pytree(os.path.join(dirpath, "state"), {
-        "params": lm.params, "opt": lm.opt,
-    })
+    # params and opt are separate ITEMS of one atomically-committed
+    # version: generations can never mix, yet a weights-only restore
+    # reads only the params item (opt is ~2x the param bytes)
+    _save_version(os.path.join(dirpath, "state"),
+                  {"params": lm.params, "opt": lm.opt})
 
 
 def restore_lm(dirpath: str, mesh: Optional[Any] = None,
@@ -161,6 +179,29 @@ def restore_lm(dirpath: str, mesh: Optional[Any] = None,
                     "v": tmap(abstract["opt"]["v"]),
                     "t": abstract["opt"]["t"]},
         }
-    state = restore_pytree(os.path.join(dirpath, "state"), abstract)
-    opt = state["opt"] if load_updater else None
-    return TransformerLM.from_state(cfg, state["params"], opt, mesh=mesh)
+
+    state_path = os.path.join(dirpath, "state")
+    base = _resolve(state_path)
+    if os.path.isdir(os.path.join(base, "params")):
+        # current layout: per-item dirs in one committed version — a
+        # weights-only restore never reads the (2x-sized) opt item
+        params = restore_pytree(state_path, abstract["params"], item="params")
+        opt = (restore_pytree(state_path, abstract["opt"], item="opt")
+               if load_updater else None)
+    elif os.path.isdir(base):
+        # transitional layout: params+opt as one combined payload
+        state = restore_pytree(state_path, abstract)
+        params, opt = state["params"], (state["opt"] if load_updater else None)
+    elif os.path.isdir(_resolve(os.path.join(dirpath, "coefficients"))):
+        # original layout: separate coefficients/updater payloads
+        params = restore_pytree(os.path.join(dirpath, "coefficients"),
+                                abstract["params"])
+        opt = None
+        if load_updater and os.path.isdir(
+                _resolve(os.path.join(dirpath, "updater"))):
+            opt = restore_pytree(os.path.join(dirpath, "updater"),
+                                 abstract["opt"])
+    else:
+        raise FileNotFoundError(
+            f"no checkpoint state found under {dirpath}")
+    return TransformerLM.from_state(cfg, params, opt, mesh=mesh)

@@ -125,6 +125,56 @@ class TestRingForward:
                                    atol=2e-4)
 
 
+class TestPipelineForward:
+    def test_matches_dense_forward(self):
+        from deeplearning4j_tpu.models.transformer import pipeline_forward
+        from jax.sharding import Mesh
+
+        cfg = _cfg(n_layers=4)
+        params = init_params(cfg)
+        x, _ = _batch(cfg, n=8)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        pp = pipeline_forward(params, x, cfg, mesh, n_micro=4)
+        dense, _ = forward(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(pp), np.asarray(dense),
+                                   atol=2e-4)
+
+    def test_gradients_match_dense(self):
+        from deeplearning4j_tpu.models.transformer import pipeline_forward
+        from jax.sharding import Mesh
+
+        cfg = _cfg(n_layers=4)
+        params = init_params(cfg)
+        x, _ = _batch(cfg, n=8)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+
+        def loss_pp(p):
+            return jnp.mean(pipeline_forward(p, x, cfg, mesh, n_micro=4) ** 2)
+
+        def loss_dense(p):
+            return jnp.mean(forward(p, x, cfg)[0] ** 2)
+
+        g_pp = jax.grad(loss_pp)(params)
+        g_d = jax.grad(loss_dense)(params)
+        for k in ("Wq", "W1"):
+            np.testing.assert_allclose(
+                np.asarray(g_pp["blocks"][k]), np.asarray(g_d["blocks"][k]),
+                atol=1e-4, err_msg=f"grad {k}")
+
+    def test_layers_not_divisible_raises(self):
+        from deeplearning4j_tpu.models.transformer import pipeline_forward
+        from jax.sharding import Mesh
+
+        import pytest
+
+        cfg = _cfg(n_layers=2)
+        params = init_params(cfg)
+        x, _ = _batch(cfg)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        with pytest.raises(ValueError):
+            pipeline_forward(params, x, cfg, mesh, n_micro=2)
+
+
 class TestGeneration:
     def test_generate_shapes_and_determinism(self):
         cfg = _cfg()
