@@ -41,6 +41,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
     MODEL_AXIS,
+    PIPELINE_AXIS,
 )
 
 Params = Dict[str, Any]
@@ -208,19 +209,23 @@ def _attention(q, k, v, n_heads, use_flash=False):
     return jnp.einsum("nhqk,nkhd->nqhd", p, v).reshape(n, t, d)
 
 
-def _dense_block_f32(bp, h, n_heads: int, attend=None):
-    """One dense transformer block in plain f32 (no flash, no casts) —
-    the block body shared by the sequence-parallel (ring_forward) and
+def _dense_block_f32(bp, h, n_heads: int, attend=None, ffn=None):
+    """One transformer block in plain f32 (no flash, no casts) — the block
+    body shared by the sequence-parallel (ring_forward) and
     pipeline-parallel (pipeline_forward) paths; forward() keeps its own
     cast-aware variant for the mixed-precision/flash path. `attend`
     overrides the attention op ((q, k, v) [N,T,F] -> [N,T,F]) so the ring/
-    Ulysses strategies plug in without copying the rest of the block."""
+    Ulysses strategies plug in; `ffn` overrides the feed-forward
+    (x_normed -> residual delta) so the MoE branch shares the
+    attention-residual half too."""
     if attend is None:
         attend = lambda q, k, v: _attention(q, k, v, n_heads)
     x = _ln(h, bp["ln1_g"], bp["ln1_b"])
     q, k, v = x @ bp["Wq"], x @ bp["Wk"], x @ bp["Wv"]
     h = h + attend(q, k, v) @ bp["Wo"]
     x = _ln(h, bp["ln2_g"], bp["ln2_b"])
+    if ffn is not None:
+        return h + ffn(x)
     return h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] + bp["b2"]
 
 
@@ -351,7 +356,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
             "accum_steps=1 or a dense FFN config")
     if cfg.lr_schedule not in ("none", "cosine"):
         raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
-                         "(know: none, cosine)")
+                         "(known: none, cosine)")
     if cfg.lr_schedule == "cosine" and cfg.total_steps <= 0:
         raise ValueError("lr_schedule='cosine' needs total_steps > 0 "
                          "(otherwise the decay is silently dropped)")
@@ -433,15 +438,9 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     L = params["blocks"]["Wq"].shape[0]
     for i in range(L):
         bp = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
-        if cfg.moe_experts:
-            x = _ln(h, bp["ln1_g"], bp["ln1_b"])
-            h = h + attend(x @ bp["Wq"], x @ bp["Wk"], x @ bp["Wv"]) \
-                @ bp["Wo"]
-            x = _ln(h, bp["ln2_g"], bp["ln2_b"])
-            y, _ = _moe_ffn(bp, x, cfg)
-            h = h + y
-        else:
-            h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend)
+        ffn = ((lambda x, bp=bp: _moe_ffn(bp, x, cfg)[0])
+               if cfg.moe_experts else None)
+        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn)
     h = _ln(h, params["lnf_g"], params["lnf_b"])
     return h @ params["embed"].T
 
@@ -453,7 +452,7 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
 def pipeline_forward(params: Params, tokens: jax.Array,
                      cfg: TransformerConfig, mesh: Mesh, *,
-                     n_micro: int) -> jax.Array:
+                     n_micro: int, axis: str = PIPELINE_AXIS) -> jax.Array:
     """Forward with the LAYER STACK sharded over the mesh's 'pipe' axis
     (parallel/pipeline_parallel.py GPipe schedule): stage s holds layers
     [s*L/S, (s+1)*L/S); microbatches flow through the ring via ppermute.
@@ -462,7 +461,7 @@ def pipeline_forward(params: Params, tokens: jax.Array,
     the backward pipeline via the scan/ppermute transposes."""
     from deeplearning4j_tpu.parallel.pipeline_parallel import pipeline_apply
 
-    n_stages = mesh.shape["pipe"]
+    n_stages = mesh.shape[axis]
     L = cfg.n_layers
     if L % n_stages != 0:
         raise ValueError(f"n_layers {L} not divisible by {n_stages} stages")
@@ -483,7 +482,7 @@ def pipeline_forward(params: Params, tokens: jax.Array,
     n, t = tokens.shape
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
     h = pipeline_apply(stage_params, h, mesh, stage_fn=stage_fn,
-                       n_micro=n_micro)
+                       n_micro=n_micro, axis=axis)
     h = _ln(h, params["lnf_g"], params["lnf_b"])
     return h @ params["embed"].T
 

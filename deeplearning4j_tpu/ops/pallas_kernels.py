@@ -49,12 +49,36 @@ from jax.experimental.pallas import tpu as pltpu
 _VMEM_BUDGET_FLOATS = 2_000_000
 
 
+_DISABLE_OVERRIDE = 0  # >0 = pallas_disabled() contexts active
+
+
+def pallas_disabled():
+    """Context manager scoping a pallas-off override to the enclosed code
+    (trace-time effect): the explicit alternative to mutating the
+    process-global DL4J_TPU_PALLAS env var. Used by the strict-equivalence
+    harness, which must compare backend MATH with identical kernels."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        global _DISABLE_OVERRIDE
+        _DISABLE_OVERRIDE += 1
+        try:
+            yield
+        finally:
+            _DISABLE_OVERRIDE -= 1
+
+    return ctx()
+
+
 def pallas_enabled() -> bool:
     """Default ON for TPU (the kernel beats lax.scan on all measured
     shapes — see module docstring); DL4J_TPU_PALLAS=0 disables. The
     special value DL4J_TPU_PALLAS=force enables even off-TPU — only
     useful for tests that monkeypatch the kernel into interpret mode
     (compiling the TPU kernel on CPU/GPU fails)."""
+    if _DISABLE_OVERRIDE:
+        return False
     env = os.environ.get("DL4J_TPU_PALLAS")
     if env in ("0", "false", "False"):
         return False

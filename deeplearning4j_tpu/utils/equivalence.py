@@ -44,15 +44,23 @@ def loss_curve(
         else contextlib.nullcontext()
     )
     dev_ctx = jax.default_device(device) if device is not None else contextlib.nullcontext()
-    with ctx, dev_ctx:
+    # strict mode compares MATH, not kernels: force the XLA paths (lax.scan
+    # LSTM, dense attention) on both legs — the default-on TPU pallas
+    # kernels are bench-verified equivalent, but their in-kernel reduction
+    # order differs, and the strict curve should isolate backend numerics
+    from deeplearning4j_tpu.ops.pallas_kernels import pallas_disabled
+
+    kern_ctx = (pallas_disabled() if matmul_precision == "float32"
+                else contextlib.nullcontext())
+    with kern_ctx, ctx, dev_ctx:
         net = net_builder()
         losses = []
         for x, y in batches:
             # keep losses device-resident: a float() per step is 100
-            # synchronous round-trips through the remote-TPU tunnel, which
-            # trips its rate limiting into minutes-long backoff sleeps
-            # (observed as a wedged north-star run); one bulk readback at
-            # the end has a data dependency on every step
+            # synchronous round-trips through the remote-TPU tunnel,
+            # which trips its rate limiting into minutes-long backoff
+            # sleeps (observed as a wedged north-star run); one bulk
+            # readback at the end has a data dependency on every step
             losses.append(net.fit(x, y))
         import jax.numpy as jnp
 

@@ -125,6 +125,21 @@ class TestRingForward:
                                    atol=2e-4)
 
 
+class TestRingForwardMoE:
+    def test_moe_ring_matches_dense(self):
+        from deeplearning4j_tpu.models.transformer import ring_forward
+        from jax.sharding import Mesh
+
+        cfg = _cfg(max_len=32, moe_experts=4, d_ff=32)
+        params = init_params(cfg)
+        x, _ = _batch(cfg)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        ring = ring_forward(params, x, cfg, mesh)
+        dense, _ = forward(params, x, cfg)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                                   atol=2e-4)
+
+
 class TestPipelineForward:
     def test_matches_dense_forward(self):
         from deeplearning4j_tpu.models.transformer import pipeline_forward
