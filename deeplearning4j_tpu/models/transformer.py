@@ -510,6 +510,7 @@ class TransformerLM:
         self.opt = init_opt_state(self.params)
         self._step = make_train_step(self._run_cfg, mesh)
         self._gen_cache: Dict[int, Any] = {}
+        self.iteration = 0
 
     @classmethod
     def from_state(cls, cfg: TransformerConfig, params: Params,
@@ -533,6 +534,30 @@ class TransformerLM:
         self.params, self.opt, loss = self._step(
             self.params, self.opt, tokens, targets)
         return loss
+
+    def fit_iterator(self, iterator, num_epochs: int = 1,
+                     listeners=()) -> "TransformerLM":
+        """fit(DataSetIterator) parity for the flagship (reference
+        MultiLayerNetwork.fit :1017 semantics): DataSets carry token ids as
+        features [N, T] and next-token ids as labels [N, T]. Works with
+        any framework iterator incl. AsyncDataSetIterator prefetch; the
+        IterationListener chain (optimize/listeners.py) is invoked with a
+        host readback only when listeners are present. The iteration
+        counter persists across calls (self.iteration — same contract as
+        MultiLayerNetwork :1017), so resumed training never re-emits
+        earlier iteration numbers to the listeners."""
+        for _ in range(num_epochs):
+            for ds in iterator:
+                loss = self.fit(jnp.asarray(ds.features, jnp.int32),
+                                jnp.asarray(ds.labels, jnp.int32))
+                self.iteration += 1
+                if listeners:
+                    score = float(loss)
+                    for lst in listeners:
+                        lst.iteration_done(self, self.iteration, score)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+        return self
 
     def logits(self, tokens: jax.Array) -> jax.Array:
         return forward(self.params, tokens, self._run_cfg)[0]

@@ -35,6 +35,7 @@ Written per /opt/skills/guides/pallas_guide.md.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import Tuple
@@ -52,23 +53,18 @@ _VMEM_BUDGET_FLOATS = 2_000_000
 _DISABLE_OVERRIDE = 0  # >0 = pallas_disabled() contexts active
 
 
+@contextlib.contextmanager
 def pallas_disabled():
     """Context manager scoping a pallas-off override to the enclosed code
     (trace-time effect): the explicit alternative to mutating the
     process-global DL4J_TPU_PALLAS env var. Used by the strict-equivalence
     harness, which must compare backend MATH with identical kernels."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def ctx():
-        global _DISABLE_OVERRIDE
-        _DISABLE_OVERRIDE += 1
-        try:
-            yield
-        finally:
-            _DISABLE_OVERRIDE -= 1
-
-    return ctx()
+    global _DISABLE_OVERRIDE
+    _DISABLE_OVERRIDE += 1
+    try:
+        yield
+    finally:
+        _DISABLE_OVERRIDE -= 1
 
 
 def pallas_enabled() -> bool:

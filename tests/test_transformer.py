@@ -125,6 +125,26 @@ class TestRingForward:
                                    atol=2e-4)
 
 
+class TestFitIterator:
+    def test_iterator_with_listeners(self):
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresIterationListener,
+        )
+
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, cfg.vocab_size, (16, cfg.max_len + 1))
+        it = ListDataSetIterator(toks[:, :-1], toks[:, 1:], batch=8,
+                                 drop_partial=True)
+        collector = CollectScoresIterationListener()
+        lm.fit_iterator(it, num_epochs=3, listeners=[collector])
+        scores = [s for _, s in collector.scores]
+        assert len(scores) == 6  # 2 batches x 3 epochs
+        assert scores[-1] < scores[0]  # training actually progresses
+
+
 class TestRingForwardMoE:
     def test_moe_ring_matches_dense(self):
         from deeplearning4j_tpu.models.transformer import ring_forward
