@@ -372,6 +372,17 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
     dt = _time_steps(lambda: lm.fit(x, y), 2, steps)
     tokens_per_sec = batch * seq * steps / dt
 
+    # fused multi-step (fit_batches: K steps per XLA program) — removes the
+    # per-step dispatch round-trip through the tunnel
+    xs = jnp.broadcast_to(x, (steps,) + x.shape)
+    ys = jnp.broadcast_to(y, (steps,) + y.shape)
+    losses = lm.fit_batches(xs, ys)  # compile + warm
+    _force(losses)
+    t0 = time.perf_counter()
+    losses = lm.fit_batches(xs, ys)
+    _force(losses)
+    fused_tokens_per_sec = batch * seq * steps / (time.perf_counter() - t0)
+
     flops = None
     try:
         lowered = lm._step.lower(lm.params, lm.opt, x, y)
@@ -388,6 +399,7 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
 
     return {
         "tokens_per_sec": round(tokens_per_sec, 1),
+        "tokens_per_sec_fused": round(fused_tokens_per_sec, 1),
         "samples_per_sec": round(batch * steps / dt, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "step_flops": flops,

@@ -125,6 +125,36 @@ class TestRingForward:
                                    atol=2e-4)
 
 
+class TestFitBatches:
+    def test_fused_equals_sequential(self):
+        cfg = _cfg()
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, cfg.vocab_size, (4, 8, cfg.max_len + 1))
+        xs = jnp.asarray(toks[..., :-1], jnp.int32)
+        ys = jnp.asarray(toks[..., 1:], jnp.int32)
+        seq = TransformerLM(cfg)
+        seq_losses = [float(seq.fit(xs[k], ys[k])) for k in range(4)]
+        fused = TransformerLM(cfg)
+        fused_losses = np.asarray(fused.fit_batches(xs, ys))
+        np.testing.assert_allclose(fused_losses, seq_losses, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(seq.output(xs[0])), np.asarray(fused.output(xs[0])),
+            atol=1e-5)
+
+    def test_fused_sharded(self):
+        cfg = _cfg()
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, cfg.vocab_size, (3, 8, cfg.max_len + 1))
+        xs = jnp.asarray(toks[..., :-1], jnp.int32)
+        ys = jnp.asarray(toks[..., 1:], jnp.int32)
+        mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
+        serial = TransformerLM(cfg)
+        ref = [float(serial.fit(xs[k], ys[k])) for k in range(3)]
+        sharded = TransformerLM(cfg, mesh=mesh)
+        got = np.asarray(sharded.fit_batches(xs, ys))
+        np.testing.assert_allclose(got, ref, rtol=1e-3)
+
+
 class TestFitIterator:
     def test_iterator_with_listeners(self):
         from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
