@@ -26,7 +26,7 @@ than one chip's HBM.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -94,7 +94,8 @@ def _pipeline_body(params: Any, x: jax.Array, *, stage_fn: StageFn,
 
 def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
                    stage_fn: StageFn, n_micro: int,
-                   axis: str = PIPELINE_AXIS) -> jax.Array:
+                   axis: str = PIPELINE_AXIS,
+                   data_axis: Optional[str] = None) -> jax.Array:
     """Run the pipelined model.
 
     params: pytree with leading stage dim [S, ...] on every leaf (S = pipe
@@ -102,7 +103,13 @@ def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
     x:      [B, ...] global batch; B must divide into n_micro microbatches.
     stage_fn(stage_params, mb) -> mb must preserve the microbatch shape
             (equal-width stages — the transformer-block case).
-    Returns [B, ...] output, replicated."""
+    data_axis: optional second mesh axis for PP x DP composition — each
+            microbatch is additionally sharded over it (the per-device
+            schedule is unchanged: the ppermute ring runs over `axis`
+            independently per data slice, so every (pipe, data) device
+            pipelines its own batch shard).
+    Returns [B, ...] output, replicated over `axis` (sharded over
+    `data_axis` when given)."""
     s = mesh.shape[axis]
     bad = [a.shape[0] for a in jax.tree_util.tree_leaves(params)
            if a.shape[0] != s]
@@ -113,15 +120,23 @@ def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
     b = x.shape[0]
     if b % n_micro != 0:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
-    xm = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+    mb = b // n_micro
+    if data_axis is not None and mb % mesh.shape[data_axis] != 0:
+        raise ValueError(
+            f"microbatch width {mb} not divisible by data-axis size "
+            f"{mesh.shape[data_axis]} (global batch {b} / n_micro {n_micro})")
+    xm = x.reshape((n_micro, mb) + x.shape[1:])
     param_specs = jax.tree_util.tree_map(
         lambda a: P(axis, *(None,) * (a.ndim - 1)), params
     )
+    # microbatches [M, mb, ...]: mb dim sharded over data_axis when present
+    x_spec = (P(None, data_axis, *(None,) * (xm.ndim - 2))
+              if data_axis is not None else P())
     fn = shard_map(
         partial(_pipeline_body, stage_fn=stage_fn, n_micro=n_micro, axis=axis),
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     out = fn(params, xm)
