@@ -671,6 +671,25 @@ def _run_isolated(name: str, quick: bool, timeout_s: int = 900,
 # failed by) the remote-TPU probe
 _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8"}
 
+_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_PARTIAL.json")
+
+
+def _persist_partial(extras: dict) -> None:
+    """Append-as-you-go artifact: rewrite BENCH_PARTIAL.json after EVERY
+    completed leg so a mid-run tunnel outage preserves finished legs (the
+    round-2 failure mode: the tunnel died mid-bench and the whole round's
+    on-chip proof was lost). Atomic rename so a crash never leaves a
+    truncated artifact."""
+    tmp = _PARTIAL_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"updated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                       "legs": extras}, f, indent=1, sort_keys=True)
+        os.replace(tmp, _PARTIAL_PATH)
+    except OSError as e:
+        _log(f"partial artifact write failed: {e}")
+
 
 def main():
     quick = "--quick" in sys.argv
@@ -688,18 +707,23 @@ def main():
             probe_err = _probe_device()
             if not probe_err:
                 break
-    if probe_err:
-        print(json.dumps({
-            "metric": "lenet5_mnist_train_throughput", "value": 0.0,
-            "unit": "samples/sec/chip", "vs_baseline": 0.0,
-            "error": f"accelerator unavailable: {probe_err}",
-        }))
-        return
-    _enable_compile_cache()
+    accel_down = bool(probe_err)
+    if not accel_down:
+        _enable_compile_cache()
     extras = {}
+    if accel_down:
+        extras["accelerator"] = {"error": f"unavailable: {probe_err}"}
 
     def run(name, fn, *a, **kw):
         if only and name not in only:
+            return
+        if accel_down and name not in _CPU_ONLY_LEGS:
+            # still record the outage per-leg, and still run (and persist)
+            # every CPU-only leg — a dead tunnel must not erase the parts
+            # of the proof that don't need it
+            extras[name] = {"error": f"accelerator unavailable: {probe_err}"}
+            if not only:  # a --only child must never clobber the artifact
+                _persist_partial(extras)
             return
         _log(f"start {name}")
         t0 = time.perf_counter()
@@ -716,6 +740,8 @@ def main():
             _log(f"FAILED {name}: {type(e).__name__}: {e}")
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
         _log(f"done {name} in {time.perf_counter() - t0:.1f}s")
+        if not only:
+            _persist_partial(extras)
 
     run("lenet5", bench_lenet, steps=10 if quick else 30)
     run("lenet5_fused", bench_lenet_fused, reps=1 if quick else 3)
@@ -743,19 +769,19 @@ def main():
         extras.get("lenet5", {}).get("samples_per_sec", 0.0),
     )
     ref = extras.get("reference_cpu_lenet5_torch", {}).get("samples_per_sec")
-    print(
-        json.dumps(
-            {
-                "metric": "lenet5_mnist_train_throughput",
-                "value": headline,
-                "unit": "samples/sec/chip",
-                # null (not a fabricated 1.0) when the baseline leg failed
-                "vs_baseline": round(headline / ref, 3) if ref else None,
-                "baseline_impl": "torch-cpu LeNet-5 (nd4j-native CPU stand-in)",
-                "extras": extras,
-            }
-        )
-    )
+    result = {
+        "metric": "lenet5_mnist_train_throughput",
+        "value": headline,
+        "unit": "samples/sec/chip",
+        # null (not a fabricated 1.0) when the baseline leg failed
+        "vs_baseline": (round(headline / ref, 3) if ref and headline
+                        else None),
+        "baseline_impl": "torch-cpu LeNet-5 (nd4j-native CPU stand-in)",
+        "extras": extras,
+    }
+    if accel_down:
+        result["error"] = f"accelerator unavailable: {probe_err}"
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -77,6 +77,9 @@ class TransformerConfig:
     # attention (pallas custom calls don't auto-partition under GSPMD —
     # multi-chip attention goes through ring_forward instead)
     use_flash: bool = True
+    # GPipe microbatch count used when TransformerLM is built on a mesh
+    # with a 'pipe' axis (pipeline mode); must divide the fit() batch size
+    pipeline_microbatches: int = 4
 
     @property
     def compute_dtype(self):
@@ -176,6 +179,28 @@ def shard_params(params: Params, cfg: TransformerConfig, mesh: Mesh) -> Params:
         lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, jnp.ndarray),
     )
+
+
+def megatron_param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def param_shardings_for_mesh(cfg: TransformerConfig, mesh: Mesh) -> Params:
+    """THE single place that decides a mesh's param layout: depth-sharded
+    (pipeline mode) when the mesh has a 'pipe' axis, Megatron/MoE GSPMD
+    specs otherwise. Training init, checkpoint restore and device_put all
+    route through here so they can never diverge."""
+    if PIPELINE_AXIS in mesh.shape:
+        return pipeline_param_shardings(cfg, mesh)
+    return megatron_param_shardings(cfg, mesh)
+
+
+def shard_params_for_mesh(params: Params, cfg: TransformerConfig,
+                          mesh: Mesh) -> Params:
+    return jax.tree_util.tree_map(
+        jax.device_put, params, param_shardings_for_mesh(cfg, mesh))
 
 
 # ---------------------------------------------------------------------------
@@ -319,6 +344,17 @@ def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
     return new, {"m": m, "v": v, "t": t}
 
 
+def _validate_schedule(cfg: TransformerConfig) -> None:
+    """Shared by the dense AND pipelined step factories — a cfg the dense
+    path rejects loudly must never train silently through the pipeline."""
+    if cfg.lr_schedule not in ("none", "cosine"):
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
+                         "(known: none, cosine)")
+    if cfg.lr_schedule == "cosine" and cfg.total_steps <= 0:
+        raise ValueError("lr_schedule='cosine' needs total_steps > 0 "
+                         "(otherwise the decay is silently dropped)")
+
+
 def _scheduled_lr(cfg: TransformerConfig, t):
     """LR at integer step t (1-based): optional linear warmup then optional
     cosine decay to zero over cfg.total_steps (standard LM schedule; the
@@ -343,12 +379,7 @@ def _build_step(cfg: TransformerConfig):
             "gradient accumulation with MoE is not full-batch equivalent "
             "(per-microbatch expert capacity + aux-loss statistics); use "
             "accum_steps=1 or a dense FFN config")
-    if cfg.lr_schedule not in ("none", "cosine"):
-        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
-                         "(known: none, cosine)")
-    if cfg.lr_schedule == "cosine" and cfg.total_steps <= 0:
-        raise ValueError("lr_schedule='cosine' needs total_steps > 0 "
-                         "(otherwise the decay is silently dropped)")
+    _validate_schedule(cfg)
 
     def step(params, opt, tokens, targets):
         if accum_steps == 1:
@@ -382,9 +413,7 @@ def _build_step(cfg: TransformerConfig):
 
 
 def _mesh_shardings(cfg: TransformerConfig, mesh: Mesh):
-    specs = param_specs(cfg)
-    pshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
-                                    is_leaf=lambda x: isinstance(x, P))
+    pshard = megatron_param_shardings(cfg, mesh)
     oshard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
     dshard = NamedSharding(mesh, P(DATA_AXIS))
     return pshard, oshard, dshard
@@ -423,17 +452,7 @@ def make_train_multi_step(cfg: TransformerConfig,
     round-trip (~5ms each through the remote-TPU tunnel). Serially
     equivalent to K fit() calls."""
     step = _build_step(cfg)
-
-    def multi(params, opt, tokens_k, targets_k):
-        def body(carry, xy):
-            params, opt = carry
-            params, opt, loss = step(params, opt, xy[0], xy[1])
-            return (params, opt), loss
-
-        (params, opt), losses = lax.scan(body, (params, opt),
-                                         (tokens_k, targets_k))
-        return params, opt, losses
-
+    multi = _multi_from_step(step)
     if mesh is None:
         return jax.jit(multi)
     pshard, oshard, dshard = _mesh_shardings(cfg, mesh)
@@ -493,13 +512,16 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
 def pipeline_forward(params: Params, tokens: jax.Array,
                      cfg: TransformerConfig, mesh: Mesh, *,
-                     n_micro: int, axis: str = PIPELINE_AXIS) -> jax.Array:
+                     n_micro: int, axis: str = PIPELINE_AXIS,
+                     data_axis: Optional[str] = None) -> jax.Array:
     """Forward with the LAYER STACK sharded over the mesh's 'pipe' axis
     (parallel/pipeline_parallel.py GPipe schedule): stage s holds layers
     [s*L/S, (s+1)*L/S); microbatches flow through the ring via ppermute.
     Embedding and the tied head run replicated outside the pipeline (they
     are a small fraction of the params). Differentiable — jax.grad gives
-    the backward pipeline via the scan/ppermute transposes."""
+    the backward pipeline via the scan/ppermute transposes. data_axis:
+    optional PP x DP composition — each microbatch additionally sharded
+    over that mesh axis."""
     from deeplearning4j_tpu.parallel.pipeline_parallel import pipeline_apply
 
     n_stages = mesh.shape[axis]
@@ -523,9 +545,135 @@ def pipeline_forward(params: Params, tokens: jax.Array,
     n, t = tokens.shape
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
     h = pipeline_apply(stage_params, h, mesh, stage_fn=stage_fn,
-                       n_micro=n_micro, axis=axis)
+                       n_micro=n_micro, axis=axis, data_axis=data_axis)
     h = _ln(h, params["lnf_g"], params["lnf_b"])
     return h @ params["embed"].T
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel TRAINING (GPipe fwd + autodiff bwd pipeline + Adam,
+# one jitted step over a ('pipe',) or ('pipe', 'data') mesh)
+# ---------------------------------------------------------------------------
+
+
+def pipeline_param_shardings(cfg: TransformerConfig, mesh: Mesh,
+                             axis: str = PIPELINE_AXIS) -> Params:
+    """NamedShardings for pipeline mode: every block leaf [L, ...] sharded
+    over 'pipe' on the LAYER dim (layer-major == stage-major because
+    pipeline_forward's [L]->[S, L/S] restack is contiguous), so each device
+    holds exactly its own stage's layers — the model can be S x larger than
+    one chip's HBM. Embedding/pos/final-LN are replicated (small)."""
+    shapes = jax.eval_shape(partial(init_params, cfg))
+    rep = NamedSharding(mesh, P())
+
+    def of(a, pipe: bool):
+        if pipe:
+            return NamedSharding(mesh, P(axis, *(None,) * (a.ndim - 1)))
+        return rep
+
+    return {
+        k: (jax.tree_util.tree_map(lambda a: of(a, True), v)
+            if k == "blocks"
+            else jax.tree_util.tree_map(lambda a: of(a, False), v))
+        for k, v in shapes.items()
+    }
+
+
+def shard_params_pipeline(params: Params, cfg: TransformerConfig, mesh: Mesh,
+                          axis: str = PIPELINE_AXIS) -> Params:
+    return jax.tree_util.tree_map(
+        jax.device_put, params, pipeline_param_shardings(cfg, mesh, axis))
+
+
+def make_pipeline_train_step(cfg: TransformerConfig, mesh: Mesh, *,
+                             n_micro: int, axis: str = PIPELINE_AXIS,
+                             data_axis: Optional[str] = None):
+    """Full pipelined TRAIN step: GPipe microbatch forward, backward
+    pipeline from autodiff (scan/ppermute transposes — microbatch gradient
+    accumulation falls out of the scan transpose), Adam update, all in ONE
+    jitted XLA program. Returns step(params, opt, tokens, targets) ->
+    (params, opt, loss), numerically the same optimizer step as the serial
+    make_train_step on the same batch (PP-train == serial-train;
+    tests/test_pipeline_training.py locks the loss curves together).
+
+    The reference has no pipeline axis at all (SURVEY.md section 2.7); this
+    is the beyond-reference leg that lets the flagship's depth exceed one
+    chip's HBM while still taking real optimizer steps."""
+    ins, outs = _pipeline_step_shardings(cfg, mesh, axis, data_axis)
+    return jax.jit(_build_pipeline_step(cfg, mesh, n_micro, axis, data_axis),
+                   in_shardings=ins, out_shardings=outs)
+
+
+def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
+    # validated HERE so every pipelined factory (single- and multi-step)
+    # rejects the unsupported configs, not just make_pipeline_train_step
+    _validate_schedule(cfg)
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "pipelined training supports dense FFN blocks (MoE routing is "
+            "batch-statistic dependent across microbatches)")
+    if cfg.accum_steps != 1:
+        raise ValueError(
+            "cfg.accum_steps must be 1 under pipelined training — n_micro "
+            "IS the microbatch/accumulation count (the GPipe schedule)")
+
+    def pp_loss(params, tokens, targets):
+        logits = pipeline_forward(params, tokens, cfg, mesh,
+                                  n_micro=n_micro, axis=axis,
+                                  data_axis=data_axis)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+
+    def step(params, opt, tokens, targets):
+        loss, grads = jax.value_and_grad(pp_loss)(params, tokens, targets)
+        lr = _scheduled_lr(cfg, opt["t"] + 1)
+        params, opt = _adam_update(params, grads, opt, lr)
+        return params, opt, loss
+
+    return step
+
+
+def _pipeline_step_shardings(cfg, mesh, axis, data_axis):
+    pshard = pipeline_param_shardings(cfg, mesh, axis)
+    oshard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
+    dshard = NamedSharding(mesh,
+                           P(data_axis) if data_axis is not None else P())
+    return ((pshard, oshard, dshard, dshard),
+            (pshard, oshard, NamedSharding(mesh, P())))
+
+
+def make_pipeline_train_multi_step(cfg: TransformerConfig, mesh: Mesh, *,
+                                   n_micro: int, axis: str = PIPELINE_AXIS,
+                                   data_axis: Optional[str] = None):
+    """K pipelined optimizer steps fused into one XLA program (lax.scan
+    over stacked batches [K, N, T] — the fit_batches dispatch-amortization
+    applied to the pipeline schedule)."""
+    step = _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis)
+    (pshard, oshard, dshard, _), (_, _, lshard) = _pipeline_step_shardings(
+        cfg, mesh, axis, data_axis)
+    kshard = NamedSharding(
+        mesh, P(None, *dshard.spec))
+    return jax.jit(
+        _multi_from_step(step),
+        in_shardings=(pshard, oshard, kshard, kshard),
+        out_shardings=(pshard, oshard, lshard),
+    )
+
+
+def _multi_from_step(step):
+    """Wrap a pure train step into a K-step lax.scan over stacked batches
+    (shared by the dense and pipelined multi-step factories)."""
+    def multi(params, opt, tokens_k, targets_k):
+        def body(carry, xy):
+            params, opt = carry
+            params, opt, loss = step(params, opt, xy[0], xy[1])
+            return (params, opt), loss
+
+        (params, opt), losses = lax.scan(body, (params, opt),
+                                         (tokens_k, targets_k))
+        return params, opt, losses
+
+    return multi
 
 
 # ---------------------------------------------------------------------------
@@ -547,11 +695,29 @@ class TransformerLM:
         self.mesh = mesh
         self.params = init_params(cfg)
         if mesh is not None:
-            self.params = shard_params(self.params, cfg, mesh)
+            # pipeline mode (depth-sharded over 'pipe') or Megatron GSPMD,
+            # decided by param_shardings_for_mesh
+            self.params = shard_params_for_mesh(self.params, cfg, mesh)
         self.opt = init_opt_state(self.params)
-        self._step = make_train_step(self._run_cfg, mesh)
+        self._step = self._make_step()
         self._gen_cache: Dict[int, Any] = {}
         self.iteration = 0
+
+    def _pipeline_mode(self) -> bool:
+        return self.mesh is not None and PIPELINE_AXIS in self.mesh.shape
+
+    def _pipeline_kwargs(self) -> Dict[str, Any]:
+        return {
+            "n_micro": self.cfg.pipeline_microbatches,
+            "data_axis": (DATA_AXIS if DATA_AXIS in self.mesh.shape
+                          else None),
+        }
+
+    def _make_step(self):
+        if self._pipeline_mode():
+            return make_pipeline_train_step(self._run_cfg, self.mesh,
+                                            **self._pipeline_kwargs())
+        return make_train_step(self._run_cfg, self.mesh)
 
     @classmethod
     def from_state(cls, cfg: TransformerConfig, params: Params,
@@ -567,7 +733,7 @@ class TransformerLM:
         lm.mesh = mesh
         lm.params = params
         lm.opt = opt if opt is not None else init_opt_state(params)
-        lm._step = make_train_step(lm._run_cfg, mesh)
+        lm._step = lm._make_step()
         lm._gen_cache = {}
         # the optimizer step count IS the training iteration — restoring it
         # keeps the listener iteration contract across checkpoint resumes
@@ -586,7 +752,12 @@ class TransformerLM:
         stacked [K, N, T]. Returns the K per-step losses. Serially
         equivalent to K fit() calls (make_train_multi_step)."""
         if getattr(self, "_multi_step", None) is None:
-            self._multi_step = make_train_multi_step(self._run_cfg, self.mesh)
+            if self._pipeline_mode():
+                self._multi_step = make_pipeline_train_multi_step(
+                    self._run_cfg, self.mesh, **self._pipeline_kwargs())
+            else:
+                self._multi_step = make_train_multi_step(self._run_cfg,
+                                                         self.mesh)
         self.params, self.opt, losses = self._multi_step(
             self.params, self.opt, tokens_k, targets_k)
         self.iteration += int(tokens_k.shape[0])
@@ -663,8 +834,12 @@ class TransformerLM:
                                              lm.params)
             if load_updater and "updater.npz" in z.namelist():
                 lm.opt = _npz_bytes_into_tree(z.read("updater.npz"), lm.opt)
+                # optimizer step count IS the training iteration (same
+                # contract as from_state): resumed runs must not re-emit
+                # earlier iteration numbers to listeners
+                lm.iteration = int(lm.opt["t"])
         if mesh is not None:
-            lm.params = shard_params(lm.params, cfg, mesh)
+            lm.params = shard_params_for_mesh(lm.params, cfg, mesh)
         return lm
 
     def _sample_fn(self, n_new: int):

@@ -207,6 +207,13 @@ def mha_apply(params, x, num_heads: int, *, causal: bool = False,
 
     q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
     if mesh is not None and SEQ_AXIS in mesh.shape:
+        if key_mask is not None:
+            raise ValueError(
+                "mha_apply: key_mask is not supported on the ring "
+                "(sequence-parallel) path — the ring body attends over "
+                "full sequence shards. Pad-free batches only, or drop "
+                "the 'seq' mesh axis for masked inputs."
+            )
         att = ring_attention_sharded(q, k, v, mesh, causal=causal)
     elif key_mask is None:
         # mask-free single-device path: flash pallas kernel when on TPU and

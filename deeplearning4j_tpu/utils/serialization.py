@@ -133,9 +133,10 @@ class ModelSerializer:
     def restore(path: str, load_updater: bool = True, mesh=None):
         """Restore any checkpoint, dispatching on the saved model_class.
         Accepts both the zip format and the sharded orbax DIRECTORY format
-        (utils/sharded_checkpoint.py). `mesh` (directory format only)
-        restores the state directly into its mesh shardings — without it a
-        mesh-scale checkpoint would materialize unsharded on one device."""
+        (utils/sharded_checkpoint.py). `mesh` restores the state into its
+        mesh shardings (Megatron specs, or depth-sharded when the mesh has
+        a 'pipe' axis) — without it a mesh-scale checkpoint would
+        materialize unsharded on one device."""
         import os
 
         if os.path.isdir(path):
@@ -158,5 +159,6 @@ class ModelSerializer:
         if meta.get("model_class") == "TransformerLM":
             from deeplearning4j_tpu.models.transformer import TransformerLM
 
-            return TransformerLM.load(path, load_updater=load_updater)
+            return TransformerLM.load(path, mesh=mesh,
+                                      load_updater=load_updater)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)

@@ -147,14 +147,11 @@ def restore_lm(dirpath: str, mesh: Optional[Any] = None,
     before the restore, so peak memory is one copy of the state — restoring
     a model near the HBM limit never doubles up on a throwaway random
     init."""
-    from jax.sharding import NamedSharding
-
     from deeplearning4j_tpu.models.transformer import (
         TransformerConfig,
         TransformerLM,
         init_opt_state,
         init_params,
-        param_specs,
     )
 
     dirpath = os.path.abspath(dirpath)
@@ -167,11 +164,17 @@ def restore_lm(dirpath: str, mesh: Optional[Any] = None,
 
     abstract = jax.eval_shape(mk)
     if mesh is not None:
-        specs = param_specs(cfg)
+        # the same layout decision training uses (pipeline vs Megatron) —
+        # restore can never diverge from how the model would train
+        from deeplearning4j_tpu.models.transformer import (
+            param_shardings_for_mesh,
+        )
+
+        shardings = param_shardings_for_mesh(cfg, mesh)
         attach = lambda a, s: jax.ShapeDtypeStruct(
-            a.shape, a.dtype, sharding=NamedSharding(mesh, s))
+            a.shape, a.dtype, sharding=s)
         is_sds = lambda x: isinstance(x, jax.ShapeDtypeStruct)
-        tmap = lambda t: jax.tree_util.tree_map(attach, t, specs,
+        tmap = lambda t: jax.tree_util.tree_map(attach, t, shardings,
                                                 is_leaf=is_sds)
         abstract = {
             "params": tmap(abstract["params"]),
