@@ -623,8 +623,17 @@ def _probe_device(timeout_s: float = 180.0) -> Optional[str]:
             import jax
             import jax.numpy as jnp
 
+            dev = jax.devices()[0]
+            if dev.platform == "cpu":
+                # jax_platforms='axon,cpu' silently falls back to CPU if the
+                # plugin errors at init — CPU numbers must NEVER be published
+                # as per-chip TPU throughput (provenance rule, CLAUDE.md)
+                result["err"] = (
+                    f"accelerator plugin fell back to CPU ({dev}); refusing "
+                    "to bench CPU as if it were the chip")
+                return
             result["ok"] = float(jnp.ones((2,)).sum())
-            result["device"] = str(jax.devices()[0])
+            result["device"] = str(dev)
         except Exception as e:  # noqa: BLE001
             result["err"] = f"{type(e).__name__}: {e}"
 

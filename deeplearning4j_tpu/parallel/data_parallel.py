@@ -261,38 +261,34 @@ class ParameterAveragingTrainer:
         self.save_updater = save_updater
         self._step_fns = {}
 
-    def _build_step(self, has_mask: bool, has_label_mask: bool):
-        """shard_map worker: local minibatch loop, then pmean of params (+
-        updater state if save_updater — reference saveUpdater flag).
+    def _build_worker(self, loss_call, update_call, combine_states,
+                      m_spec, lm_spec):
+        """ONE copy of the averaging semantics, shared by both containers
+        (the reference drives MLN and CG through the same
+        ParameterAveragingTrainingMaster — ExecuteWorkerFlatMap.java:35-100):
+        local minibatch scan, then pmean of params (+ updater state if
+        save_updater — reference saveUpdater flag, :416-434 averages both).
+        Container-specific pieces arrive as callables: the loss invocation,
+        the updater application, and the state-averaging rule.
 
-        States: batch-statistics states (BN running mean/var — params in the
-        reference, so they ARE averaged, BatchNormalizationParamInitializer)
-        are pmean'd; recurrent stream states are NOT (reference workers are
-        rebuilt from broadcast each split, ExecuteWorkerFlatMap.java:35-100 —
-        worker RNN state never crosses the averaging boundary): they pass
-        through unchanged."""
+        States rule (combine_states): batch-statistics states (BN running
+        mean/var — params in the reference, so they ARE averaged,
+        BatchNormalizationParamInitializer) are pmean'd; recurrent stream
+        states are NOT (workers are rebuilt from broadcast each split —
+        worker RNN state never crosses the averaging boundary)."""
         net = self.net
         save_updater = self.save_updater
-        from deeplearning4j_tpu.nn.layers.factory import STATEFUL_RNN_CONFS
 
-        def worker(params, states, upd_state, xs, ys, ms, lms, iteration, rngs):
-            # xs: [freq, local_b, ...] — this worker's minibatch sequence
+        def worker(params, states, upd_state, xs, ys, ms, lms, iteration,
+                   rngs):
+            # xs: [freq, local_b, ...] leaves — this worker's minibatches
             def body(carry, inp):
                 params, st, upd_state, it = carry
                 (x, y, m, lm), r = inp
-
-                def loss_fn(p):
-                    return net._loss(
-                        p, st, x, y, train=True, rng=r, mask=m,
-                        label_mask=lm,
-                    )
-
                 (loss, new_states), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
+                    lambda p: loss_call(p, st, x, y, r, m, lm), has_aux=True
                 )(params)
-                updates, upd_state2 = net.updater.update(
-                    grads, upd_state, params, it
-                )
+                updates, upd_state2 = update_call(grads, upd_state, params, it)
                 params = apply_updates(params, updates, net.conf.minimize)
                 return (params, new_states, upd_state2, it + 1), loss
 
@@ -304,25 +300,15 @@ class ParameterAveragingTrainer:
             params = jax.lax.pmean(params, DATA_AXIS)
             if save_updater:
                 upd_state = jax.lax.pmean(upd_state, DATA_AXIS)
-            final_states = [
-                (
-                    st_in  # recurrent stream state: local, not averaged
-                    if isinstance(net.conf.layers[i], STATEFUL_RNN_CONFS)
-                    else jax.lax.pmean(st_out, DATA_AXIS)
-                )
-                for i, (st_in, st_out) in enumerate(zip(states, out_states))
-            ]
             return (
                 params,
-                final_states,
+                combine_states(states, out_states),
                 upd_state,
                 jax.lax.pmean(jnp.mean(losses), DATA_AXIS),
             )
 
         repl = P()
-        sharded = P(None, DATA_AXIS)  # [freq, global_b, ...] split on batch axis
-        m_spec = sharded if has_mask else repl
-        lm_spec = sharded if has_label_mask else repl
+        sharded = P(None, DATA_AXIS)  # [freq, global_b, ...]: batch sharded
         fn = shard_map(
             worker,
             mesh=self.mesh,
@@ -332,6 +318,59 @@ class ParameterAveragingTrainer:
             check_vma=False,
         )
         return jax.jit(fn)
+
+    def _build_step(self, has_mask: bool, has_label_mask: bool):
+        """MultiLayerNetwork worker (list states, one shared updater)."""
+        net = self.net
+        from deeplearning4j_tpu.nn.layers.factory import STATEFUL_RNN_CONFS
+
+        def combine(states, out_states):
+            return [
+                (
+                    st_in  # recurrent stream state: local, not averaged
+                    if isinstance(net.conf.layers[i], STATEFUL_RNN_CONFS)
+                    else jax.lax.pmean(st_out, DATA_AXIS)
+                )
+                for i, (st_in, st_out) in enumerate(zip(states, out_states))
+            ]
+
+        sharded, repl = P(None, DATA_AXIS), P()
+        return self._build_worker(
+            loss_call=lambda p, st, x, y, r, m, lm: net._loss(
+                p, st, x, y, train=True, rng=r, mask=m, label_mask=lm),
+            update_call=net.updater.update,
+            combine_states=combine,
+            m_spec=sharded if has_mask else repl,
+            lm_spec=sharded if has_label_mask else repl,
+        )
+
+    def _build_step_graph(self, n_labels: int, has_label_masks: bool):
+        """ComputationGraph worker (SparkComputationGraph.java:68 fit drives
+        the same master): dict inputs/masks keyed by input name, per-output
+        label lists, per-vertex state dicts and updaters (net._update_all)."""
+        net = self.net
+        from deeplearning4j_tpu.nn.layers.factory import STATEFUL_RNN_CONFS
+
+        def combine(states, out_states):
+            return {
+                n: (
+                    states[n]  # recurrent stream state: local, not averaged
+                    if isinstance(net.conf.vertices[n], STATEFUL_RNN_CONFS)
+                    else jax.lax.pmean(out_states[n], DATA_AXIS)
+                )
+                for n in out_states
+            }
+
+        sharded, repl = P(None, DATA_AXIS), P()  # prefix spec: every leaf
+        return self._build_worker(
+            loss_call=lambda p, st, x, y, r, m, lm: net._loss(
+                p, st, x, y, train=True, rng=r, masks=m or None,
+                label_masks=lm),
+            update_call=net._update_all,
+            combine_states=combine,
+            m_spec=sharded,
+            lm_spec=sharded if has_label_masks else repl,
+        )
 
     def _to_rounds(self, a):
         """[freq*gb, ...] -> [freq, gb, ...] minibatch stacking."""
@@ -345,13 +384,65 @@ class ParameterAveragingTrainer:
             )
         return a
 
+    def _step_rngs(self):
+        net = self.net
+        return jax.vmap(lambda i: rng_mod.step_key(net._rng, i))(
+            jnp.arange(net.iteration,
+                       net.iteration + self.averaging_frequency)
+        )
+
+    def _fit_graph(self, features, labels, masks=None,
+                   label_masks=None) -> float:
+        """One ComputationGraph averaging round (SparkComputationGraph.fit
+        semantics): features/labels may be single arrays or per-input /
+        per-output lists; masks a per-input dict-or-list; label_masks a
+        per-output list."""
+        from deeplearning4j_tpu.nn.graph import _as_list
+
+        net = self.net
+        inputs = net._as_inputs(features)
+        labels_l = [jnp.asarray(l) for l in _as_list(labels)]
+        if len(labels_l) != len(net.conf.outputs):
+            raise ValueError(
+                f"expected {len(net.conf.outputs)} label arrays, "
+                f"got {len(labels_l)}"
+            )
+        x = {k: self._to_rounds(v) for k, v in inputs.items()}
+        y = [self._to_rounds(l) for l in labels_l]
+        ms = {k: self._to_rounds(v)
+              for k, v in net._as_masks(masks).items()}
+        lms = (
+            [None if m is None else self._to_rounds(m) for m in label_masks]
+            if label_masks is not None
+            else None
+        )
+        first = next(iter(x.values()))
+        if hasattr(net, "_reset_rnn_states"):
+            net._reset_rnn_states(first.shape[1] // self.n)
+        key = ("graph", len(y), lms is not None)
+        if key not in self._step_fns:
+            self._step_fns[key] = self._build_step_graph(
+                len(y), lms is not None)
+        net.params, net.states, net.updater_state, loss = self._step_fns[key](
+            net.params, net.states, net.updater_state, x, y, ms, lms,
+            jnp.asarray(net.iteration, jnp.int32), self._step_rngs(),
+        )
+        net.iteration += self.averaging_frequency
+        net._score_dev = loss  # CG exposes score via the score_value property
+        return loss
+
     def fit(self, features, labels, mask=None, label_mask=None) -> float:
         """One averaging round: features [freq*n*b, ...] or [freq, n*b, ...].
         Feature/label masks (variable-length sequences) shard with the batch
-        (reference workers pass the DataSet's mask arrays to net.fit)."""
+        (reference workers pass the DataSet's mask arrays to net.fit).
+        Accepts both containers — MultiLayerNetwork (array features/labels)
+        and ComputationGraph (array-or-list features/labels), the same
+        duality as ParallelWrapper.fit."""
         net = self.net
         if net.params is None:
             net.init()
+        if hasattr(net, "_as_inputs"):  # ComputationGraph
+            return self._fit_graph(features, labels, mask, label_mask)
         x = self._to_rounds(features)
         y = self._to_rounds(labels)
         m = self._to_rounds(mask)
@@ -364,9 +455,6 @@ class ParameterAveragingTrainer:
         key = (m is not None, lm is not None)
         if key not in self._step_fns:
             self._step_fns[key] = self._build_step(*key)
-        rngs = jax.vmap(lambda i: rng_mod.step_key(net._rng, i))(
-            jnp.arange(net.iteration, net.iteration + self.averaging_frequency)
-        )
         net.params, net.states, net.updater_state, loss = self._step_fns[key](
             net.params,
             net.states,
@@ -376,7 +464,7 @@ class ParameterAveragingTrainer:
             m,
             lm,
             jnp.asarray(net.iteration, jnp.int32),
-            rngs,
+            self._step_rngs(),
         )
         net.iteration += self.averaging_frequency
         net.score_value = loss

@@ -129,18 +129,49 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def _splits(self, datasets: List[DataSet]):
         """Concatenate and re-split so each split is exactly
         workers x batch x freq examples (repartition=Always; the reference's
-        Balanced repartition becomes an exact reshape here)."""
-        x = np.concatenate([np.asarray(d.features) for d in datasets])
-        y = np.concatenate([np.asarray(d.labels) for d in datasets])
+        Balanced repartition becomes an exact reshape here). Features/labels
+        may be per-component LISTS (multi-input/multi-output
+        ComputationGraph — the reference's MultiDataSet); every component is
+        permuted and sliced with the same index set."""
+
+        def cat(get):
+            first = get(datasets[0])
+            if isinstance(first, (list, tuple)):
+                return [
+                    np.concatenate([np.asarray(get(d)[i]) for d in datasets])
+                    for i in range(len(first))
+                ]
+            return np.concatenate([np.asarray(get(d)) for d in datasets])
+
+        # DataSet carries arrays (or component lists); MultiDataSet carries
+        # features_list/labels_list — normalize the accessors
+        def accessor(multi_attr, single_attr):
+            def get(d):
+                comp = getattr(d, multi_attr, None)
+                if comp is not None:
+                    if not comp:
+                        raise ValueError(
+                            f"{type(d).__name__}.{multi_attr} is empty")
+                    return comp
+                return getattr(d, single_attr)
+
+            return get
+
+        x = cat(accessor("features_list", "features"))
+        y = cat(accessor("labels_list", "labels"))
+        take = lambda comp, idx: (
+            [c[idx] for c in comp] if isinstance(comp, list) else comp[idx]
+        )
+        n = (x[0] if isinstance(x, list) else x).shape[0]
         if self.repartition == Repartition.ALWAYS:
             # vary the shuffle per call (the reference repartitions each fit)
             rng = np.random.default_rng(self.rng_seed + self._round)
             self._round += 1
-            order = rng.permutation(len(x))
-            x, y = x[order], y[order]
+            order = rng.permutation(n)
+            x, y = take(x, order), take(y, order)
         per = self._examples_per_split()
-        n_full = len(x) // per
-        dropped = len(x) - n_full * per
+        n_full = n // per
+        dropped = n - n_full * per
         if dropped:
             # static shard_map shapes require whole averaging rounds; the
             # shuffle rotates which examples land in the tail across rounds
@@ -150,19 +181,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             )
         for s in range(n_full):
             sl = slice(s * per, (s + 1) * per)
-            yield x[sl], y[sl]
+            yield take(x, sl), take(y, sl)
 
     # -- TrainingMaster contract ------------------------------------------
     def execute_training(self, net, iterator) -> None:
         """fit(JavaRDD<DataSet>) analog (SparkDl4jMultiLayer.fit:194-230 →
-        executeTraining:163): per split, one averaging round on the mesh."""
-        if hasattr(net, "_as_inputs"):
-            raise NotImplementedError(
-                "ParameterAveragingTrainingMaster drives the shard_map "
-                "worker loop, which currently supports MultiLayerNetwork "
-                "only; wrap ComputationGraph training in ParallelWrapper "
-                "(gradient DP) instead"
-            )
+        executeTraining:163; SparkComputationGraph.fit:68 for graphs): per
+        split, one averaging round on the mesh. Drives BOTH containers —
+        the trainer dispatches on MultiLayerNetwork vs ComputationGraph."""
         if self._trainer is None or self._trainer_net is not net:
             self._trainer = ParameterAveragingTrainer(
                 net,
@@ -181,6 +207,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 f"(need {self._examples_per_split()})"
             )
         for x, y in splits:
+            # x may be a per-component LIST (multi-input graph): the example
+            # count is the leading dim of a component, not the list length
+            n_examples = (x[0] if isinstance(x, list) else x).shape[0]
             attempt = 0
             while True:
                 try:
@@ -190,7 +219,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     if stats:  # record successful attempts only
                         stats.record(
                             "fit", t0, (time.perf_counter() - p0) * 1000.0,
-                            example_count=len(x),
+                            example_count=n_examples,
                         )
                     break
                 except Exception:
@@ -234,8 +263,8 @@ class DistributedEvaluator:
 
 class SparkStyleNetwork:
     """User-facing wrapper pairing a net with a TrainingMaster
-    (SparkDl4jMultiLayer role; for ComputationGraph use ParallelWrapper —
-    the averaging master's worker loop is MLN-only for now)."""
+    (SparkDl4jMultiLayer / SparkComputationGraph role — both containers
+    train under the averaging master)."""
 
     def __init__(self, net, training_master: TrainingMaster):
         self.net = net

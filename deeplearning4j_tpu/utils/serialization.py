@@ -133,10 +133,13 @@ class ModelSerializer:
     def restore(path: str, load_updater: bool = True, mesh=None):
         """Restore any checkpoint, dispatching on the saved model_class.
         Accepts both the zip format and the sharded orbax DIRECTORY format
-        (utils/sharded_checkpoint.py). `mesh` restores the state into its
-        mesh shardings (Megatron specs, or depth-sharded when the mesh has
-        a 'pipe' axis) — without it a mesh-scale checkpoint would
-        materialize unsharded on one device."""
+        (utils/sharded_checkpoint.py). `mesh` restores TransformerLM state
+        into its mesh shardings (Megatron specs, or depth-sharded when the
+        mesh has a 'pipe' axis) — without it a mesh-scale checkpoint would
+        materialize unsharded on one device. MLN/ComputationGraph zips
+        ignore mesh (they train replicated under ParallelWrapper, which
+        places params itself) — a warning is logged so the drop is never
+        silent."""
         import os
 
         if os.path.isdir(path):
@@ -154,11 +157,20 @@ class ModelSerializer:
                 f"{meta.get('model_class')!r} at {path}")
         with zipfile.ZipFile(path, "r") as z:
             meta = json.loads(z.read("metadata.json").decode())
-        if meta.get("model_class") == "ComputationGraph":
-            return ModelSerializer.restore_computation_graph(path, load_updater)
         if meta.get("model_class") == "TransformerLM":
             from deeplearning4j_tpu.models.transformer import TransformerLM
 
             return TransformerLM.load(path, mesh=mesh,
                                       load_updater=load_updater)
+        if mesh is not None:
+            import logging
+
+            logging.getLogger("deeplearning4j_tpu").warning(
+                "ModelSerializer.restore: mesh ignored for %s zip "
+                "checkpoints (params restore replicated; wrap in "
+                "ParallelWrapper to train on the mesh)",
+                meta.get("model_class", "MultiLayerNetwork"),
+            )
+        if meta.get("model_class") == "ComputationGraph":
+            return ModelSerializer.restore_computation_graph(path, load_updater)
         return ModelSerializer.restore_multi_layer_network(path, load_updater)

@@ -4,16 +4,25 @@
 # capture the final JSON line. Round-2 lesson: the tunnel can be down for
 # hours and die mid-round — capture the proof the moment it's possible.
 cd /root/repo || exit 1
+# axon plugin registration needs /root/.axon_site on PYTHONPATH (CLAUDE.md);
+# without it jax silently falls back to CPU and the probe would loop forever
+export PYTHONPATH="/root/repo:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
 PROBE='
 import threading, sys
 res = {}
 def work():
     try:
         import jax, jax.numpy as jnp
+        dev = jax.devices()[0]
+        if dev.platform == "cpu":
+            # CPU fallback must not masquerade as a live TPU tunnel
+            res["err"] = f"cpu fallback: {dev}"; return
         res["ok"] = float(jnp.ones((2,)).sum())
     except Exception as e:
         res["err"] = str(e)
 t = threading.Thread(target=work, daemon=True); t.start(); t.join(150)
+if "err" in res:
+    print("probe error:", res["err"], file=sys.stderr)
 sys.exit(0 if "ok" in res else 1)
 '
 while true; do
