@@ -410,6 +410,59 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
     }
 
 
+def bench_ring_attention(n=1, t=4096, h=8, d=64, steps=5):
+    """Long-context ring attention: local block product through the pallas
+    flash kernel (ops/pallas_attention.flash_attention_block) vs the einsum
+    body, on a 1-device 'seq' mesh — the only ring THIS host can run (one
+    chip); the multi-device collective schedule is validated on the virtual
+    mesh (tests + dryrun), and what changes between the two paths is
+    exactly the per-device local block compute timed here. The einsum body
+    materializes the [N,H,T,T] score block; the kernel streams it through
+    VMEM."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.parallel.sequence_parallel import (
+        ring_attention_sharded,
+    )
+
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jax.device_put(jnp.asarray(
+            rng.standard_normal((n, t, h, d)), jnp.bfloat16))
+        for _ in range(3)
+    )
+    mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
+    out = {"shape": f"n{n} t{t} h{h} d{d}",
+           "note": ("1-device ring (one real chip on this host): times the "
+                    "local block product the kernel replaces; collective "
+                    "schedule equivalence is proven on the virtual mesh")}
+    for name, uf in (("einsum", False), ("flash", True)):
+        fn = jax.jit(lambda q, k, v, uf=uf: ring_attention_sharded(
+            q, k, v, mesh, causal=True, use_flash=uf))
+        o = fn(q, k, v)
+        _force(o)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            o = fn(q, k, v)
+        _force(o)
+        out[f"ring_{name}_ms"] = round(
+            (time.perf_counter() - t0) / steps * 1000, 3)
+    out["flash_speedup"] = round(
+        out["ring_einsum_ms"] / out["ring_flash_ms"], 2)
+    # feed the measured-win gate: ring_attention_sharded's auto path turns
+    # the kernel on only when this committed row proves it (kernel_gate)
+    from deeplearning4j_tpu.ops.kernel_gate import record_win
+
+    record_win("attention", "ring_local_flash", {
+        "speedup": out["flash_speedup"], "shape": out["shape"],
+        "einsum_ms": out["ring_einsum_ms"],
+        "flash_ms": out["ring_flash_ms"], "backend": "tpu",
+    })
+    return out
+
+
 def bench_flash_attention(n=4, t=2048, h=8, d=64, steps=10):
     """Flash pallas kernel vs dense XLA attention, same shapes, fwd only."""
     import jax
@@ -445,6 +498,33 @@ def bench_flash_attention(n=4, t=2048, h=8, d=64, steps=10):
     else:
         out["flash_ms"] = None
         out["note"] = "pallas off or shape unfit; dense path only"
+
+    # masked variant: extended kernel (key bias) vs dense-masked — the row
+    # that gates attention_auto's masked default (kernel_gate rent rule)
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        _dense_masked,
+        ext_fits,
+        flash_attention_masked,
+    )
+
+    km = jax.device_put(jnp.asarray(rng.random((n, t)) > 0.2))
+    dm_j = jax.jit(lambda q, k, v, km: _dense_masked(q, k, v, km,
+                                                     causal=True))
+    dt_dm = _time_steps(lambda: dm_j(q, k, v, km), 2, steps)
+    out["masked_dense_ms"] = round(dt_dm / steps * 1000, 3)
+    if pallas_enabled() and ext_fits(t, t, d):
+        fm_j = jax.jit(lambda q, k, v, km: flash_attention_masked(
+            q, k, v, km, causal=True))
+        dt_fm = _time_steps(lambda: fm_j(q, k, v, km), 2, steps)
+        out["masked_flash_ms"] = round(dt_fm / steps * 1000, 3)
+        out["masked_speedup"] = round(dt_dm / dt_fm, 2)
+        from deeplearning4j_tpu.ops.kernel_gate import record_win
+
+        record_win("attention", "masked_flash", {
+            "speedup": out["masked_speedup"], "shape": out["shape"],
+            "dense_ms": out["masked_dense_ms"],
+            "flash_ms": out["masked_flash_ms"], "backend": "tpu",
+        })
     return out
 
 
@@ -763,6 +843,7 @@ def main():
     run("mxu_calibration", bench_mxu_calibration, steps=3 if quick else 10)
     run("transformer_lm", bench_transformer, steps=2 if quick else 5)
     run("flash_attention", bench_flash_attention, steps=3 if quick else 10)
+    run("ring_attention", bench_ring_attention, steps=2 if quick else 5)
     run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
     run("scaling_virtual8", bench_scaling)
     run("north_star", bench_north_star, steps=10 if quick else 100)

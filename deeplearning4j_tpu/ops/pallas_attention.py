@@ -22,10 +22,13 @@ Scope & fallback policy (mirrors ops/pallas_kernels.py):
     log-sum-exp, and the custom_vjp recomputes probabilities K-block by
     K-block (lax.scan), so neither pass ever materializes the [T, T]
     score matrix;
-  - causal and full attention; no padding mask (masked batches fall back);
+  - causal and full attention; key padding masks run through the EXTENDED
+    kernel (_flash_ext: additive key bias + traced visibility offset),
+    which also powers the ring's local block product
+    (flash_attention_block — shard-level causality as qi + off >= ki);
   - engages when pallas is enabled (ops.pallas_kernels.pallas_enabled) and
-    the k/v rows fit VMEM (flash_fits); else dense XLA attention;
-  - CPU tests run the same kernel under interpret=True.
+    the k/v rows fit VMEM (flash_fits / ext_fits); else dense XLA;
+  - CPU tests run the same kernels under interpret=True.
 """
 
 from __future__ import annotations
@@ -34,8 +37,10 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from deeplearning4j_tpu.ops.pallas_kernels import pallas_enabled
 
@@ -210,6 +215,192 @@ def _flash_bwd(causal, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Extended kernel: additive key bias (padding masks) + TRACED causal offset
+# (ring attention). Kept separate from _flash so the mask-free single-device
+# hot path (and its PALLAS_BENCH numbers) is untouched.
+#
+# The offset generalizes causal masking to sequence SHARDS: a key is visible
+# iff qi + off >= ki (local indices). off = 0 is plain causal; off >= T makes
+# everything visible (non-causal); off <= -T hides everything (a ring step
+# whose K/V shard lies entirely in the future). Because off is a traced
+# scalar (scalar-prefetch SMEM operand), the SAME compiled kernel serves
+# every step of a lax.scan ring schedule — which is what lets the ring's
+# local block product run through pallas at all.
+# ---------------------------------------------------------------------------
+
+
+def _flash_ext_kernel(off_ref, q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+                      *, scale: float, block_k: int):
+    """Like _flash_kernel plus: kb_ref [1, 8, T] additive key bias (0 keeps,
+    -inf masks; row 0 is real, rows 1-7 Mosaic sublane padding) and off_ref
+    scalar-prefetch visibility offset."""
+    off = off_ref[0]
+    q = q_ref[0].astype(jnp.float32) * scale
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    qi = pl.program_id(1) * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        kb = kb_ref[0, 0, pl.dslice(j * block_k, block_k)]  # [Bk] f32
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+        s = s + kb[None, :]
+        ki = j * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        s = jnp.where(qi + off >= ki, s, -jnp.inf)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))
+        return m_new, l, acc
+
+    # off is traced, so no static causal truncation of the key loop (the
+    # ring's shards are short; the full sweep is the price of one kernel
+    # serving every ring step)
+    m, l, acc = lax.fori_loop(0, t // block_k, body, (m0, l0, a0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # rows with NO visible key keep lse = log(1e-30) ~ -69; their output is
+    # exactly 0, so any cross-shard combination weight exp(lse - M) * 0 = 0
+    lse_ref[0] = jnp.broadcast_to(
+        (m_safe_final(m) + jnp.log(l_safe))[None, :], (8, l.shape[0]))
+
+
+def _flash_ext_raw(q, k, v, kb, off, *, interpret: bool):
+    """q,k,v: [B, Tq, D] / [B, Tk, D]; kb: [B, 8, Tk] f32 additive key bias;
+    off: [1] i32 -> (out [B, Tq, D], lse [B, 8, Tq]). Tq and Tk may differ
+    (ring steps attend a local Q shard against a rotating K/V shard)."""
+    b, tq, d = q.shape
+    tk = k.shape[1]
+    if tq % _BLOCK_Q != 0 or tk % _BLOCK_K != 0:
+        raise ValueError(
+            f"flash_ext needs Tq % {_BLOCK_Q} == 0 and Tk % {_BLOCK_K} == 0; "
+            f"got Tq={tq}, Tk={tk}")
+    scale = 1.0 / (d ** 0.5)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, tq // _BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, _BLOCK_Q, d), lambda b, i, off: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i, off: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i, off: (b, 0, 0)),
+            pl.BlockSpec((1, 8, tk), lambda b, i, off: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _BLOCK_Q, d), lambda b, i, off: (b, i, 0)),
+            pl.BlockSpec((1, 8, _BLOCK_Q), lambda b, i, off: (b, 0, i)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_flash_ext_kernel, scale=scale, block_k=_BLOCK_K),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, 8, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(off, q, k, v, kb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _flash_ext(q, k, v, kb, off, interpret):
+    return _flash_ext_raw(q, k, v, kb, off, interpret=interpret)
+
+
+def _flash_ext_fwd(q, k, v, kb, off, interpret):
+    o, lse = _flash_ext_raw(q, k, v, kb, off, interpret=interpret)
+    return (o, lse), (q, k, v, kb, off, o, lse[:, 0, :])
+
+
+def _flash_ext_bwd(interpret, res, gs):
+    """Blocked XLA backward (same identities as _flash_bwd) with the key
+    bias and visibility offset applied when recomputing probabilities,
+    PLUS the lse cotangent: ring callers combine shard results through the
+    returned log-sum-exp, so dL/dlse_i contributes p_ij to dS (the softmax
+    jacobian of logsumexp). Masked/invisible keys have p = 0, hence zero
+    dK/dV — exact."""
+    q, k, v, kb, off, o, lse = res
+    g, g_lse = gs
+    b, tq, d = q.shape
+    tk = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    f32 = lambda a: a.astype(jnp.float32)
+    q32, k32, v32, g32 = f32(q), f32(k), f32(v), f32(g)
+    # the kernel emits lse broadcast over 8 sublanes; fold the cotangent
+    g_lse_row = (f32(g_lse).sum(axis=1) if g_lse is not None
+                 else jnp.zeros((b, tq), jnp.float32))
+    kb_row = kb[:, 0, :]                                # [B, Tk]
+    Dvec = (g32 * f32(o)).sum(-1)                       # [B, Tq]
+    nb = tk // _BLOCK_K
+    qi = jnp.arange(tq)
+
+    def block(dq, j):
+        ks = lax.dynamic_slice_in_dim(k32, j * _BLOCK_K, _BLOCK_K, 1)
+        vs = lax.dynamic_slice_in_dim(v32, j * _BLOCK_K, _BLOCK_K, 1)
+        kbs = lax.dynamic_slice_in_dim(kb_row, j * _BLOCK_K, _BLOCK_K, 1)
+        s = jnp.einsum("bqd,bkd->bqk", q32, ks) * scale + kbs[:, None, :]
+        ki = j * _BLOCK_K + jnp.arange(_BLOCK_K)
+        s = jnp.where((qi[:, None] + off[0] >= ki[None, :])[None], s,
+                      -jnp.inf)
+        p = jnp.exp(s - lse[..., None])                 # invisible -> 0
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, g32)
+        dp = jnp.einsum("bqd,bkd->bqk", g32, vs)
+        ds = p * (dp - Dvec[..., None]
+                  + g_lse_row[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, q32)
+        return dq, (dk_j, dv_j)
+
+    dq, (dks, dvs) = lax.scan(block, jnp.zeros_like(q32), jnp.arange(nb))
+    unstack = lambda a: a.transpose(1, 0, 2, 3).reshape(b, tk, d)
+    return (dq.astype(q.dtype), unstack(dks).astype(k.dtype),
+            unstack(dvs).astype(v.dtype), jnp.zeros_like(kb),
+            np.zeros(off.shape, jax.dtypes.float0))
+
+
+_flash_ext.defvjp(_flash_ext_fwd, _flash_ext_bwd)
+
+
+def flash_attention_block(q, k, v, *, offset, key_mask=None,
+                          interpret: bool = False):
+    """Flash attention of a Q shard against a K/V shard with shard-level
+    causal visibility (qi + offset >= ki) and an optional key padding mask.
+
+    q,k,v: [B, Tq, D] / [B, Tk, D] (B = batch*heads, heads already folded);
+    offset: traced i32 scalar (see module notes); key_mask: [B, Tk] 0/1.
+    Returns (out [B, Tq, D], lse [B, Tq]) — the log-sum-exp lets callers
+    combine shard results exactly (ring attention's online softmax)."""
+    b, _, _ = q.shape
+    tk = k.shape[1]
+    if key_mask is None:
+        kb = jnp.zeros((b, 8, tk), jnp.float32)
+    else:
+        km = jnp.asarray(key_mask, bool)
+        kb = jnp.broadcast_to(
+            jnp.where(km, 0.0, -jnp.inf).astype(jnp.float32)[:, None, :],
+            (b, 8, tk))
+    off = jnp.asarray(offset, jnp.int32).reshape((1,))
+    o, lse = _flash_ext(q, k, v, kb, off, interpret)
+    return o, lse[:, 0, :]
+
+
+def ext_fits(tq: int, tk: int, d: int) -> bool:
+    """VMEM gate for the extended kernel (K + V + bias resident)."""
+    return (tq % _BLOCK_Q == 0 and tk % _BLOCK_K == 0
+            and 2 * tk * d + 8 * tk <= _KV_BUDGET_FLOATS)
+
+
 def _apply_folded(fn, q, k, v):
     """Run fn on [N*H, T, D]-folded q/k/v and unfold back to [N, T, H, D]."""
     n, t, h, d = q.shape
@@ -232,11 +423,61 @@ def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
         lambda q, k, v: _dense_reference(q, k, v, causal=causal), q, k, v)
 
 
-def attention_auto(q, k, v, *, causal: bool = False) -> jax.Array:
+def _fold_heads(x):
+    n, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(n * h, t, d)
+
+
+def _unfold_heads(x, n, h):
+    b, t, d = x.shape
+    return x.reshape(n, h, t, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_masked(q, k, v, key_mask, *, causal: bool = False,
+                           interpret: bool = False) -> jax.Array:
+    """q,k,v: [N, T, H, D]; key_mask: [N, T] 0/1 — flash attention with
+    padded keys excluded from the softmax (the extended kernel's key bias;
+    previously masked batches always fell back to dense XLA attention)."""
+    n, t, h, d = q.shape
+    km = jnp.repeat(jnp.asarray(key_mask, bool), h, axis=0)  # [N*H, T]
+    off = t if not causal else 0
+    o, _ = flash_attention_block(
+        _fold_heads(q), _fold_heads(k), _fold_heads(v),
+        offset=off, key_mask=km, interpret=interpret)
+    return _unfold_heads(o, n, h)
+
+
+def _dense_masked(q, k, v, key_mask, *, causal: bool):
+    """Dense fallback with a key padding mask, [N, T, H, D] layout."""
+    d = q.shape[-1]
+    s = jnp.einsum("nqhd,nkhd->nhqk", q, k).astype(jnp.float32) / (d ** 0.5)
+    if causal:
+        t = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s,
+                      -jnp.inf)
+    km = jnp.asarray(key_mask, bool)[:, None, None, :]
+    s = jnp.where(km, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("nhqk,nkhd->nqhd", p.astype(q.dtype), v)
+
+
+def attention_auto(q, k, v, *, causal: bool = False,
+                   key_mask=None) -> jax.Array:
     """Backend registry slot (the reference's reflective cuDNN-helper
     pattern, ConvolutionLayer.java:64-70): flash kernel when pallas is on
-    and the shape fits VMEM, dense XLA attention otherwise."""
+    and the shape fits VMEM, dense XLA attention otherwise. key_mask
+    ([N, T] 0/1) runs through the extended kernel's key bias — default-on
+    only once PALLAS_BENCH.json proves the ext kernel on chip (the
+    measured-win rent rule, ops/kernel_gate.py)."""
+    from deeplearning4j_tpu.ops.kernel_gate import measured_win
+
     t, d = q.shape[1], q.shape[3]
+    if key_mask is not None:
+        if (pallas_enabled() and ext_fits(t, t, d)
+                and measured_win("attention", "masked_flash")):
+            return flash_attention_masked(q, k, v, key_mask, causal=causal)
+        return _dense_masked(q, k, v, key_mask, causal=causal)
     if pallas_enabled() and flash_fits(t, d):
         return flash_attention(q, k, v, causal=causal)
     return dense_attention(q, k, v, causal=causal)

@@ -73,10 +73,11 @@ def multi_head_attention(q, k, v, *, causal: bool = False,
 # ---------------------------------------------------------------------------
 
 
-def _ring_attention_body(q, k, v, *, causal: bool, t_local: int,
-                         axis_name: str = SEQ_AXIS):
-    """Per-device body. q,k,v: [N, T_local, H, D] shards. Exact full
-    attention via online softmax over rotating K/V blocks."""
+def _ring_attention_body(q, k, v, key_mask=None, *, causal: bool,
+                         t_local: int, axis_name: str = SEQ_AXIS):
+    """Per-device body. q,k,v: [N, T_local, H, D] shards; key_mask an
+    optional [N, T_local] 0/1 shard that rotates with its K/V block. Exact
+    full attention via online softmax over rotating K/V blocks."""
     n_dev = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     n, tq, h, d = q.shape
@@ -89,9 +90,12 @@ def _ring_attention_body(q, k, v, *, causal: bool, t_local: int,
     o = jnp.zeros((n, tq, h, d), jnp.float32)
 
     q_pos = my * t_local + jnp.arange(tq)
+    # the mask shard (when present) travels around the ring WITH its K/V
+    # block; the mask-free hot path carries (and ppermutes) nothing extra
+    km0 = () if key_mask is None else (jnp.asarray(key_mask, bool),)
 
     def step_fn(carry, step):
-        m, l, o, k_blk, v_blk = carry
+        m, l, o, k_blk, v_blk, km_blk = carry
         # the block currently held arrived from device (my - step) mod n_dev
         src = (my - step) % n_dev
         s = jnp.einsum("nqhd,nkhd->nhqk", q32, k_blk.astype(jnp.float32))
@@ -100,6 +104,8 @@ def _ring_attention_body(q, k, v, *, causal: bool, t_local: int,
             k_pos = src * t_local + jnp.arange(t_local)
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, -jnp.inf)
+        if key_mask is not None:
+            s = jnp.where(km_blk[0][:, None, None, :], s, -jnp.inf)
         blk_max = jnp.max(s, axis=-1)  # [N,H,Tq]
         m_new = jnp.maximum(m, blk_max)
         # guard -inf - -inf
@@ -111,36 +117,137 @@ def _ring_attention_body(q, k, v, *, causal: bool, t_local: int,
         o = o * jnp.moveaxis(corr, 1, 2)[..., None] + jnp.einsum(
             "nhqk,nkhd->nqhd", p, v_blk.astype(jnp.float32)
         )
-        # rotate K/V one step around the ring
+        # rotate K/V (and the mask that travels with them) around the ring
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (m_new, l, o, k_blk, v_blk), None
+        km_blk = tuple(lax.ppermute(km, axis_name, perm) for km in km_blk)
+        return (m_new, l, o, k_blk, v_blk, km_blk), None
 
-    (m, l, o, _, _), _ = lax.scan(
-        step_fn, (m, l, o, k, v), jnp.arange(n_dev)
+    (m, l, o, _, _, _), _ = lax.scan(
+        step_fn, (m, l, o, k, v, km0), jnp.arange(n_dev)
     )
     denom = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
     return (o / denom).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False):
+def _ring_attention_body_flash(q, k, v, key_mask=None, *, causal: bool,
+                               t_local: int, axis_name: str = SEQ_AXIS,
+                               interpret: bool = False):
+    """Ring body with the LOCAL block product running through the pallas
+    flash kernel (ops/pallas_attention.flash_attention_block — the
+    composition that module's header promises): per ring step the kernel
+    returns (block_out, lse) and the shard results are combined exactly in
+    log space. The kernel's TRACED visibility offset (qi + off >= ki with
+    off = (my - src) * t_local) expresses shard-level causality, so one
+    compiled kernel serves every step of the lax.scan ring."""
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        _fold_heads,
+        _unfold_heads,
+        flash_attention_block,
+    )
+
+    n_dev = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    n, tq, h, d = q.shape
+
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    # mask shard travels with its K/V block; mask-free path carries nothing
+    km0 = () if key_mask is None else (jnp.asarray(key_mask, bool),)
+
+    # combined accumulators over ring steps: running max M of the lse,
+    # denominator l (in M scale), numerator o (in M scale)
+    M = jnp.full((n * h, tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((n * h, tq), jnp.float32)
+    o = jnp.zeros((n * h, tq, d), jnp.float32)
+
+    def step_fn(carry, step):
+        M, l, o, k_blk, v_blk, km_blk = carry
+        src = (my - step) % n_dev
+        # visible iff my*t+qi >= src*t+ki  <=>  qi + (my-src)*t >= ki;
+        # non-causal: off = t_local*n_dev makes every key visible
+        off = ((my - src) * t_local) if causal else t_local * n_dev
+        o_b, lse_b = flash_attention_block(
+            qf, k_blk, v_blk, offset=off,
+            key_mask=(jnp.repeat(km_blk[0], h, axis=0) if km_blk else None),
+            interpret=interpret)
+        M_new = jnp.maximum(M, lse_b)
+        M_safe = jnp.where(jnp.isfinite(M_new), M_new, 0.0)
+        corr = jnp.where(jnp.isfinite(M), jnp.exp(M - M_safe), 0.0)
+        w = jnp.exp(lse_b - M_safe)
+        l = l * corr + w
+        o = o * corr[..., None] + w[..., None] * o_b.astype(jnp.float32)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        km_blk = tuple(lax.ppermute(km, axis_name, perm) for km in km_blk)
+        return (M_new, l, o, k_blk, v_blk, km_blk), None
+
+    (M, l, o, _, _, _), _ = lax.scan(
+        step_fn, (M, l, o, kf, vf, km0), jnp.arange(n_dev))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return _unfold_heads(out, n, h).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False,
+                           key_mask=None, use_flash: Optional[bool] = None,
+                           interpret: bool = False):
     """Full exact attention with the SEQUENCE dimension sharded over
-    mesh axis 'seq'. q,k,v: [N, T, H, D] with T divisible by the axis size."""
+    mesh axis 'seq'. q,k,v: [N, T, H, D] with T divisible by the axis size.
+    key_mask: optional [N, T] 0/1, sharded with the keys (padded timesteps
+    excluded exactly — the mask shard rotates with its K/V block).
+    use_flash: run the local block product through the pallas flash kernel
+    (ops/pallas_attention.py); default auto — on when pallas is enabled and
+    the local shard fits the kernel's block/VMEM constraints."""
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        ext_fits,
+        pallas_enabled,
+    )
+
     n_dev = mesh.shape[SEQ_AXIS]
     t = q.shape[1]
     if t % n_dev != 0:
         raise ValueError(f"sequence length {t} not divisible by {n_dev} devices")
     t_local = t // n_dev
+    if use_flash is None:
+        # default-on needs BOTH the fit check and a committed on-chip win
+        # (kernel_gate rent rule); explicit use_flash=True bypasses only
+        # the win check
+        from deeplearning4j_tpu.ops.kernel_gate import measured_win
+
+        use_flash = (pallas_enabled()
+                     and ext_fits(t_local, t_local, q.shape[-1])
+                     and measured_win("attention", "ring_local_flash"))
+    elif use_flash and not ext_fits(t_local, t_local, q.shape[-1]):
+        raise ValueError(
+            f"use_flash=True but the local shard (T_local={t_local}, "
+            f"D={q.shape[-1]}) does not fit the kernel's block/VMEM "
+            "constraints (ops/pallas_attention.ext_fits); use more/fewer "
+            "'seq' devices or use_flash=False")
+    body = (_ring_attention_body_flash if use_flash
+            else _ring_attention_body)
+    kwargs = dict(causal=causal, t_local=t_local)
+    if use_flash:
+        kwargs["interpret"] = interpret
     spec = P(None, SEQ_AXIS, None, None)
+    m_spec = P(None, SEQ_AXIS)
+    if key_mask is None:
+        fn = shard_map(
+            partial(body, **kwargs),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
     fn = shard_map(
-        partial(_ring_attention_body, causal=causal, t_local=t_local),
+        partial(body, **kwargs),
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, m_spec),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, key_mask)
 
 
 # ---------------------------------------------------------------------------
@@ -207,21 +314,15 @@ def mha_apply(params, x, num_heads: int, *, causal: bool = False,
 
     q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
     if mesh is not None and SEQ_AXIS in mesh.shape:
-        if key_mask is not None:
-            raise ValueError(
-                "mha_apply: key_mask is not supported on the ring "
-                "(sequence-parallel) path — the ring body attends over "
-                "full sequence shards. Pad-free batches only, or drop "
-                "the 'seq' mesh axis for masked inputs."
-            )
-        att = ring_attention_sharded(q, k, v, mesh, causal=causal)
-    elif key_mask is None:
-        # mask-free single-device path: flash pallas kernel when on TPU and
-        # the shape fits VMEM, dense XLA otherwise (one dispatch policy —
-        # ops/pallas_attention.attention_auto)
+        # the mask shard rotates with its K/V block through the ring, so
+        # padded timesteps are excluded exactly even across shards
+        att = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                     key_mask=key_mask)
+    else:
+        # single-device path: ONE dispatch policy (attention_auto) — flash
+        # pallas kernel when on TPU and the shape fits VMEM (masked batches
+        # ride the extended kernel's key bias), dense XLA otherwise
         from deeplearning4j_tpu.ops.pallas_attention import attention_auto
 
-        att = attention_auto(q, k, v, causal=causal)
-    else:
-        att = multi_head_attention(q, k, v, causal=causal, key_mask=key_mask)
+        att = attention_auto(q, k, v, causal=causal, key_mask=key_mask)
     return att.reshape(n, t, proj) @ params["Wo"]

@@ -1,0 +1,64 @@
+"""Measured-win gate (ops/kernel_gate.py): default-on requires a committed
+on-chip PALLAS_BENCH.json row beating the XLA twin."""
+
+import json
+
+import pytest
+
+from deeplearning4j_tpu.ops import kernel_gate
+
+
+@pytest.fixture
+def artifact(tmp_path, monkeypatch):
+    path = tmp_path / "PALLAS_BENCH.json"
+    monkeypatch.setattr(kernel_gate, "_ARTIFACT", str(path))
+    kernel_gate.reload()
+    yield path
+    kernel_gate.reload()
+
+
+def test_no_artifact_defaults_off(artifact):
+    assert not kernel_gate.measured_win("attention", "ring_local_flash")
+    assert kernel_gate.measured_win("attention", "x", default=True)
+
+
+def test_tpu_win_row_enables(artifact):
+    artifact.write_text(json.dumps(
+        {"attention": {"ring_local_flash":
+                       {"speedup": 1.4, "backend": "tpu"}}}))
+    kernel_gate.reload()
+    assert kernel_gate.measured_win("attention", "ring_local_flash")
+
+
+def test_loss_row_disables(artifact):
+    artifact.write_text(json.dumps(
+        {"attention": {"ring_local_flash":
+                       {"speedup": 0.9, "backend": "tpu"}}}))
+    kernel_gate.reload()
+    assert not kernel_gate.measured_win("attention", "ring_local_flash")
+
+
+def test_cpu_or_interpret_rows_do_not_count(artifact):
+    artifact.write_text(json.dumps(
+        {"attention": {"a": {"speedup": 2.0, "backend": "cpu"},
+                       "b": {"speedup": 2.0, "interpret": True,
+                             "backend": "tpu"}}}))
+    kernel_gate.reload()
+    assert not kernel_gate.measured_win("attention", "a")
+    assert not kernel_gate.measured_win("attention", "b")
+
+
+def test_record_win_merges_and_enables(artifact):
+    artifact.write_text(json.dumps(
+        {"lstm_legacy": {"keep": {"speedup": 9.9}}}))
+    kernel_gate.reload()
+    kernel_gate.record_win("attention", "masked_flash",
+                           {"speedup": 1.2, "backend": "tpu"})
+    assert kernel_gate.measured_win("attention", "masked_flash")
+    data = json.loads(artifact.read_text())
+    assert data["lstm_legacy"]["keep"]["speedup"] == 9.9  # preserved
+
+
+def test_force_env_overrides(artifact, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_PALLAS_FORCE", "1")
+    assert kernel_gate.measured_win("attention", "anything")

@@ -85,3 +85,97 @@ def test_attention_auto_dense_fallback():
     out = attention_auto(q, k, v, causal=True)
     ref = _dense_nthd(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Extended kernel: key padding mask + traced visibility offset
+# ---------------------------------------------------------------------------
+
+
+def test_ext_masked_matches_dense_masked():
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        _dense_masked,
+        flash_attention_masked,
+    )
+
+    q, k, v = _qkv()
+    rng = np.random.default_rng(3)
+    km = rng.random((2, 256)) > 0.3
+    for causal in (False, True):
+        out = flash_attention_masked(q, k, v, km, causal=causal,
+                                     interpret=True)
+        ref = _dense_masked(q, k, v, km, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_ext_offset_visibility():
+    """offset generalizes causality to shards: off=0 == causal, off>=T ==
+    full, off<=-T == nothing visible (zero output)."""
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        flash_attention_block,
+    )
+
+    rng = np.random.default_rng(0)
+    b, t, d = 4, 256, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+               for _ in range(3))
+    from deeplearning4j_tpu.ops.pallas_attention import _dense_reference
+
+    o0, _ = flash_attention_block(q, k, v, offset=0, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(o0), np.asarray(_dense_reference(q, k, v, causal=True)),
+        atol=2e-5)
+    of, _ = flash_attention_block(q, k, v, offset=t, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(of), np.asarray(_dense_reference(q, k, v, causal=False)),
+        atol=2e-5)
+    oh, _ = flash_attention_block(q, k, v, offset=-t, interpret=True)
+    assert float(jnp.max(jnp.abs(oh))) == 0.0
+
+
+def test_ext_gradients_include_lse_cotangent():
+    """Gradients through BOTH outputs (o and lse) match the dense oracle —
+    the lse cotangent is what ring combination differentiates through."""
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        flash_attention_block,
+    )
+
+    rng = np.random.default_rng(1)
+    b, t, d = 2, 256, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, d)), jnp.float32)
+               for _ in range(3))
+
+    def f(q, k, v):
+        o, lse = flash_attention_block(q, k, v, offset=0, interpret=True)
+        return (o ** 2).mean() + 0.01 * lse.mean()
+
+    def f_ref(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(d)
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, -1)
+        o = jnp.einsum("bqk,bkd->bqd", p, v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return (o ** 2).mean() + 0.01 * lse.mean()
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5,
+                                   err_msg=f"d{name}")
+
+
+def test_attention_auto_masked_dispatch():
+    """attention_auto with a key_mask must agree between its two backends
+    (ext kernel vs dense fallback)."""
+    from deeplearning4j_tpu.ops.pallas_attention import (
+        _dense_masked,
+        attention_auto,
+    )
+
+    q, k, v = _qkv()
+    rng = np.random.default_rng(5)
+    km = rng.random((2, 256)) > 0.4
+    out = attention_auto(q, k, v, causal=True, key_mask=km)
+    ref = _dense_masked(q, k, v, km, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

