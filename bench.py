@@ -400,6 +400,10 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
     return {
         "tokens_per_sec": round(tokens_per_sec, 1),
         "tokens_per_sec_fused": round(fused_tokens_per_sec, 1),
+        # the TPU-first story quantified: K steps per XLA program vs one
+        # dispatch per step (~5ms tunnel overhead each — BENCH_NOTES.md)
+        "fused_over_per_step": round(fused_tokens_per_sec / tokens_per_sec,
+                                     2),
         "samples_per_sec": round(batch * steps / dt, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "step_flops": flops,
@@ -690,6 +694,19 @@ def bench_north_star(steps=100, timeout=1800):
     return parsed if parsed is not None else {"error": err}
 
 
+def bench_lstm_kernel(timeout=2400):
+    """Fused pallas LSTM fwd AND fwd+bwd vs lax.scan on chip
+    (benchmarks/pallas_lstm_bench.py) — writes the PALLAS_BENCH.json
+    win-table rows that gate the kernel per shape class. Runs as its own
+    subprocess (fresh tunnel, same reasoning as the north-star leg)."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "pallas_lstm_bench.py")
+    parsed, err = _run_subprocess_json([sys.executable, script], timeout)
+    if parsed is None:
+        return {"error": err}
+    return {"cases": parsed.get("cases"), "verdict": parsed.get("verdict")}
+
+
 def _probe_device(timeout_s: float = 180.0) -> Optional[str]:
     """Liveness probe: run a tiny op with a hard deadline in a worker
     thread. A dead remote-TPU tunnel HANGS (no error), which would wedge
@@ -783,6 +800,14 @@ def _persist_partial(extras: dict) -> None:
 def main():
     quick = "--quick" in sys.argv
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--only=")]
+    # --trace[=DIR]: capture an xplane trace per leg (children inherit the
+    # env; SURVEY section 5 profiling mapping — utils/profiling.py)
+    for a in sys.argv:
+        if a == "--trace":
+            os.environ["DL4J_TPU_XPLANE_TRACE"] = "xplane_traces"
+        elif a.startswith("--trace="):
+            os.environ["DL4J_TPU_XPLANE_TRACE"] = a.split("=", 1)[1]
+    trace_dir = os.environ.get("DL4J_TPU_XPLANE_TRACE")
     if only and all(name in _CPU_ONLY_LEGS for name in only):
         probe_err = None
     else:
@@ -818,9 +843,20 @@ def main():
         t0 = time.perf_counter()
         try:
             if only:
-                # child mode (--only=...): run in THIS process
-                extras[name] = fn(*a, **kw)
-            elif name in ("scaling_virtual8", "north_star"):
+                # child mode (--only=...): run in THIS process, under an
+                # xplane trace when --trace/DL4J_TPU_XPLANE_TRACE is set
+                if trace_dir:
+                    from deeplearning4j_tpu.utils.profiling import (
+                        xplane_trace,
+                    )
+
+                    with xplane_trace(os.path.join(trace_dir, name)):
+                        extras[name] = fn(*a, **kw)
+                    extras[name]["xplane_trace"] = os.path.join(
+                        trace_dir, name)
+                else:
+                    extras[name] = fn(*a, **kw)
+            elif name in ("scaling_virtual8", "north_star", "lstm_kernel"):
                 # already subprocess-isolated internally
                 extras[name] = fn(*a, **kw)
             else:
@@ -842,9 +878,16 @@ def main():
         dtype_policy="performance")
     run("mxu_calibration", bench_mxu_calibration, steps=3 if quick else 10)
     run("transformer_lm", bench_transformer, steps=2 if quick else 5)
+    # MFU chase (VERDICT round-2 #7): the largest (d_model, batch) that
+    # fits HBM with the blocked-flash backward — depth doubled vs the
+    # round-2 best-MFU config (d2048 L4 b16 -> 0.110)
+    if not quick:
+        run("transformer_lm_big", bench_transformer, steps=3,
+            batch=16, seq=1024, d_model=2048, n_layers=8, heads=32)
     run("flash_attention", bench_flash_attention, steps=3 if quick else 10)
     run("ring_attention", bench_ring_attention, steps=2 if quick else 5)
     run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
+    run("lstm_kernel", bench_lstm_kernel)
     run("scaling_virtual8", bench_scaling)
     run("north_star", bench_north_star, steps=10 if quick else 100)
     if only:

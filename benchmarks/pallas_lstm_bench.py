@@ -162,8 +162,45 @@ def main():
                 "The kernel is DEFAULT ON for TPU (DL4J_TPU_PALLAS=0 "
                 "disables)"
             )
-    with open("PALLAS_BENCH.json", "w") as f:
-        json.dump(results, f, indent=1)
+    # Merge into PALLAS_BENCH.json (never clobber other kernel groups —
+    # the attention rows live in the same artifact) and emit the per-shape
+    # win-table rows that ops/pallas_kernels.lstm_kernel_wins consults.
+    # CPU/interpret smoke runs must NOT touch the artifact: they would
+    # replace real-chip rows with timing-meaningless ones and silently
+    # disable the kernel everywhere (the gate ignores non-chip rows, but
+    # same-key overwrites would delete the chip evidence).
+    if not is_tpu:
+        print(json.dumps(results))
+        return
+    from deeplearning4j_tpu.ops.kernel_gate import _ARTIFACT, record_win
+
+    for c in results["cases"]:
+        if "pallas_ms" not in c:
+            continue
+        row = {
+            "n": c["n"], "t": c["t"], "h": c["h"],
+            "speedup": round(c["scan_ms"] / c["pallas_ms"], 2),
+            "scan_ms": c["scan_ms"], "pallas_ms": c["pallas_ms"],
+            "backend": results["backend"],
+            "interpret": c["pallas_interpret_mode"],
+        }
+        if "pallas_fwdbwd_ms" in c:
+            row["fwdbwd_speedup"] = round(
+                c["scan_fwdbwd_ms"] / c["pallas_fwdbwd_ms"], 2)
+            row["scan_fwdbwd_ms"] = c["scan_fwdbwd_ms"]
+            row["pallas_fwdbwd_ms"] = c["pallas_fwdbwd_ms"]
+            row["bwd_kernel_engaged"] = c.get("bwd_kernel_engaged")
+        record_win("lstm", f"n{c['n']}_t{c['t']}_h{c['h']}", row)
+    try:
+        with open(_ARTIFACT) as f:
+            merged = json.load(f)
+    except (OSError, ValueError):
+        merged = {}
+    merged.update({k: v for k, v in results.items()})
+    tmp = _ARTIFACT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=1)
+    os.replace(tmp, _ARTIFACT)
     print(json.dumps(results))
 
 

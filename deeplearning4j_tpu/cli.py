@@ -9,6 +9,12 @@ saves the model (…/cli/subcommands/Train.java:129-227, local path
 by extension (.csv — last column is the integer class label; .npz — arrays
 'features'/'labels').
 
+SCOPE NOTE: local runtime only, by design — the reference CLI's
+Spark/Hadoop branches (hdfs:// URIs, cluster submission) coordinate JVMs,
+which has no analog on a single-controller TPU host; distributed training
+is reached through the library surface (parallel/ TrainingMaster,
+ParallelWrapper) instead of CLI dispatch.
+
 Usage:
   python -m deeplearning4j_tpu.cli train   --conf conf.json --input train.csv \
       --output model.zip [--epochs N] [--batch B]

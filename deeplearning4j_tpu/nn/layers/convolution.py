@@ -45,13 +45,23 @@ class ConvolutionLayerImpl(BaseLayerImpl):
     def preout(self, params, x):
         conf = self.conf
         pad = [(conf.padding[0],) * 2, (conf.padding[1],) * 2]
-        y = lax.conv_general_dilated(
-            x,
-            params["W"],
+        kwargs = dict(
             window_strides=conf.stride,
             padding=pad,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
+        from deeplearning4j_tpu.ops.precision import (
+            conv_f32_3pass,
+            strict_conv_active,
+        )
+
+        if strict_conv_active():
+            # north-star strict mode: f32-class conv via three DEFAULT-
+            # precision passes (ops/precision.py — the HIGHEST-precision
+            # conv compile wedges the remote TPU compile helper)
+            y = conv_f32_3pass(x, params["W"], **kwargs)
+        else:
+            y = lax.conv_general_dilated(x, params["W"], **kwargs)
         return y + params["b"]
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):

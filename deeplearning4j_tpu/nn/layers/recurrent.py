@@ -101,7 +101,8 @@ def _scan_lstm(act, params, x, h0, c0, mask, reverse=False, is_tanh=False,
         # kernel's launch overhead loses to the fused scan (measured).
         from deeplearning4j_tpu.ops import pallas_kernels as pk
 
-        if pk.pallas_enabled() and pk.lstm_scan_fits(n, n_out, t):
+        if (pk.pallas_enabled() and pk.lstm_scan_fits(n, n_out, t)
+                and pk.lstm_kernel_wins(n, n_out, t)):
             hs, h_f, c_f = pk.lstm_pallas_scan(
                 xproj, params["U"], params["p"], h0, c0
             )

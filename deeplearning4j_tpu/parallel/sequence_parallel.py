@@ -230,24 +230,19 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False,
     if use_flash:
         kwargs["interpret"] = interpret
     spec = P(None, SEQ_AXIS, None, None)
-    m_spec = P(None, SEQ_AXIS)
-    if key_mask is None:
-        fn = shard_map(
-            partial(body, **kwargs),
-            mesh=mesh,
-            in_specs=(spec, spec, spec),
-            out_specs=spec,
-            check_vma=False,
-        )
-        return fn(q, k, v)
+    args = (q, k, v)
+    in_specs = (spec, spec, spec)
+    if key_mask is not None:
+        args += (key_mask,)
+        in_specs += (P(None, SEQ_AXIS),)
     fn = shard_map(
         partial(body, **kwargs),
         mesh=mesh,
-        in_specs=(spec, spec, spec, m_spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v, key_mask)
+    return fn(*args)
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,16 @@ expiry + job-reclaim semantics (the failure-detection behavior the
 reference gets from Hazelcast), and a file-based registry standing in for
 znodes. Multi-controller deployments point the registry at a shared
 filesystem and the semantics carry over.
+
+SCOPE NOTE (explicit, per round-2 review): the reference's
+BaseHazelCastStateTracker.java:49 plane is genuinely CROSS-PROCESS
+(Hazelcast cluster members over TCP); this tracker is in-process BY
+DESIGN. On TPU the data plane that actually moves tensors is XLA
+collectives over ICI (parallel/{data,tensor,…}_parallel.py) and
+multi-host control is jax.distributed (parallel/multihost.py) — a
+host-side distributed KV store would duplicate what the runtime already
+provides. What this module preserves is the reference's CONTROL-PLANE
+SEMANTICS (queue/heartbeat/reclaim/routing), testable in one process.
 """
 
 from __future__ import annotations

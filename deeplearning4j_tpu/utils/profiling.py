@@ -1,0 +1,122 @@
+"""Xplane (TPU profiler) trace capture — SURVEY.md section 5's profiling
+mapping: the reference exports Spark training stats as an HTML timeline
+(dl4j-spark/.../spark/stats/StatsUtils.java:65 exportStatsAsHtml); the
+TPU-native equivalent of its per-phase drill-down is an XLA xplane trace
+(`jax.profiler.trace`), viewable in TensorBoard/XProf, LINKED from the
+stats timeline so the two views cover host-side phases and device-side op
+time respectively.
+
+Surfaces:
+  - `xplane_trace(logdir)`: context manager around any region (a fit call,
+    a bench leg);
+  - `XplaneTraceListener`: IterationListener that captures iterations
+    [start_iteration, start_iteration + num_iterations) of a fit loop —
+    the listener-chain integration (reference listener role);
+  - `TrainingStats.link_trace(...)` via `link_stats`: records the trace
+    directory as a timeline event so the HTML/JSON exports point at it;
+  - bench.py `--trace=DIR` flag / DL4J_TPU_XPLANE_TRACE env: every bench
+    leg runs under a trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+@contextlib.contextmanager
+def xplane_trace(logdir: str, enabled: bool = True):
+    """Capture an xplane trace of the enclosed region into `logdir`
+    (TensorBoard: `tensorboard --logdir=DIR`, or xprof). No-op (with a
+    log line) when the profiler is unavailable or already active."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    os.makedirs(logdir, exist_ok=True)
+    # guard ONLY profiler entry/exit — exceptions raised inside the traced
+    # region must propagate unchanged (a swallowed re-yield would mask the
+    # region's real error with "generator didn't stop after throw()")
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception as e:  # noqa: BLE001 — profiling must never kill a run
+        logger.warning("xplane trace failed (%s: %s); region runs untraced",
+                       type(e).__name__, e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("xplane trace stop failed: %s", e)
+
+
+def link_stats(stats, logdir: str) -> None:
+    """Record the trace directory in a TrainingStats timeline so the
+    exported HTML/JSON links device-side op time to the host-side phases
+    (the reference's StatsUtils single-pane-of-glass role)."""
+    if stats is None:
+        return
+    stats.record("xplane_trace:" + os.path.abspath(logdir),
+                 stats.time_source.current_time_millis(), 0.0)
+
+
+class XplaneTraceListener:
+    """IterationListener that traces a window of training iterations:
+    capture starts when `start_iteration` is reached and stops after
+    `num_iterations` more have completed. Attach like any listener
+    (optimize/listeners.py chain; reference IterationListener role)."""
+
+    def __init__(self, logdir: str, start_iteration: int = 2,
+                 num_iterations: int = 3, stats=None):
+        self.logdir = logdir
+        self.start_iteration = start_iteration
+        self.num_iterations = num_iterations
+        self.stats = stats
+        self._active = False
+        self._done = False
+
+    def iteration_done(self, model, iteration: int, score: float) -> None:
+        import jax
+
+        if self._done:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            os.makedirs(self.logdir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self.logdir)
+                self._active = True
+                self._stop_at = iteration + self.num_iterations
+            except Exception as e:  # noqa: BLE001
+                logger.warning("xplane listener could not start trace: %s", e)
+                self._done = True
+            return
+        if self._active and iteration >= getattr(self, "_stop_at", 0):
+            self.stop()
+
+    def stop(self) -> None:
+        """Stop the trace if active (also called by __del__ safety net)."""
+        import jax
+
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("xplane listener stop failed: %s", e)
+            self._active = False
+            self._done = True
+            link_stats(self.stats, self.logdir)
+
+    def __del__(self):  # pragma: no cover - interpreter-shutdown best effort
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001
+            pass

@@ -53,3 +53,67 @@ def test_matmul_precision_context_applies():
     c_native = loss_curve(_lenet_builder, batches, matmul_precision=None)
     assert np.isfinite(c_strict).all() and np.isfinite(c_native).all()
     np.testing.assert_allclose(c_strict, c_native, rtol=1e-6)
+
+
+class TestStrictConv3Pass:
+    def test_decomposition_matches_highest_precision_conv(self):
+        """bf16x3 conv (ops/precision.py) must be f32-class accurate vs the
+        true f32 conv — the bound that makes the strict north-star leg
+        honest (VERDICT round-2 #2)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        from deeplearning4j_tpu.ops.precision import conv_f32_3pass
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 12, 12, 3)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(5, 5, 3, 8)) * 0.2, jnp.float32)
+        kwargs = dict(window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+                      dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        exact = lax.conv_general_dilated(
+            x, w, precision=lax.Precision.HIGHEST, **kwargs)
+        approx = conv_f32_3pass(x, w, **kwargs)
+        rel = float(jnp.max(jnp.abs(approx - exact))
+                    / jnp.max(jnp.abs(exact)))
+        assert rel < 1e-5, f"bf16x3 conv relative error {rel}"
+
+    def test_strict_context_engages_layer_path(self):
+        """Under strict_conv_3pass() the conv LAYER output changes by at
+        most the decomposition bound and by at least something nonzero
+        (proves the 3-pass path actually ran)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+        from deeplearning4j_tpu.nn.layers.factory import create_layer
+        from deeplearning4j_tpu.ops.precision import strict_conv_3pass
+
+        conf = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(3, 3),
+                                weight_init="xavier", activation="identity")
+        impl = create_layer(conf)
+        params, state, _ = impl.initialize(jax.random.PRNGKey(0), (8, 8, 3))
+        x = jnp.asarray(
+            np.random.default_rng(1).normal(size=(2, 8, 8, 3)),
+            jnp.float32)
+        y_plain, _ = impl.apply(params, state, x)
+        with strict_conv_3pass():
+            y_strict, _ = impl.apply(params, state, x)
+        dev = float(jnp.max(jnp.abs(y_plain - y_strict)))
+        scale = float(jnp.max(jnp.abs(y_plain)))
+        assert dev > 0.0, "3-pass path did not engage (outputs identical)"
+        assert dev / scale < 1e-5
+
+    def test_north_star_strict_cpu_determinism_with_3pass(self):
+        """Two same-backend strict runs (both through the decomposition)
+        must be bit-identical — the determinism bar with the new conv
+        path engaged."""
+        from deeplearning4j_tpu.utils.equivalence import (
+            compare_backends,
+            mnist_batches,
+        )
+        from deeplearning4j_tpu.models.lenet import build_lenet5
+
+        res = compare_backends(lambda: build_lenet5(seed=3),
+                               mnist_batches(3, batch=16))
+        assert res["same_backend"]
+        assert res["max_abs_deviation"] == 0.0

@@ -62,3 +62,45 @@ def test_record_win_merges_and_enables(artifact):
 def test_force_env_overrides(artifact, monkeypatch):
     monkeypatch.setenv("DL4J_TPU_PALLAS_FORCE", "1")
     assert kernel_gate.measured_win("attention", "anything")
+
+
+class TestLstmWinTable:
+    def test_nearest_shape_class_decides(self, artifact):
+        artifact.write_text(json.dumps({"lstm": {
+            "small": {"n": 32, "t": 128, "h": 128, "speedup": 0.93,
+                      "backend": "tpu", "interpret": False},
+            "large": {"n": 128, "t": 512, "h": 512, "speedup": 2.2,
+                      "backend": "tpu", "interpret": False},
+        }}))
+        kernel_gate.reload()
+        from deeplearning4j_tpu.ops.pallas_kernels import lstm_kernel_wins
+
+        assert not lstm_kernel_wins(32, 128, 128)   # nearest: losing row
+        assert lstm_kernel_wins(128, 512, 512)      # nearest: winning row
+        assert lstm_kernel_wins(256, 512, 1024)     # beyond largest: wins
+
+    def test_legacy_cases_rows_parse(self, artifact):
+        artifact.write_text(json.dumps({"cases": [
+            {"n": 64, "t": 256, "h": 256, "scan_ms": 2.4, "pallas_ms": 1.5,
+             "pallas_interpret_mode": False,
+             "scan_speedup_over_pallas": 0.63},
+        ]}))
+        kernel_gate.reload()
+        from deeplearning4j_tpu.ops.pallas_kernels import lstm_kernel_wins
+
+        assert lstm_kernel_wins(64, 256, 256)
+
+    def test_no_rows_defaults_off(self, artifact):
+        from deeplearning4j_tpu.ops.pallas_kernels import lstm_kernel_wins
+
+        assert not lstm_kernel_wins(64, 256, 256)
+
+    def test_committed_artifact_small_class_off_large_on(self):
+        """The REAL committed artifact (round-2 chip rows): scan won the
+        smallest class (ratio 1.07), kernel won the larger two."""
+        from deeplearning4j_tpu.ops.pallas_kernels import lstm_kernel_wins
+
+        kernel_gate.reload()
+        assert not lstm_kernel_wins(32, 128, 128)
+        assert lstm_kernel_wins(64, 256, 256)
+        assert lstm_kernel_wins(128, 512, 512)

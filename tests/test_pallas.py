@@ -75,6 +75,10 @@ class TestLayerIntegration:
         import deeplearning4j_tpu.ops.pallas_kernels as pk_mod
 
         monkeypatch.setattr(pk_mod, "pallas_enabled", lambda: True)
+        # bypass the measured-win shape table too — this test forces the
+        # kernel path regardless of what the committed artifact says
+        monkeypatch.setattr(pk_mod, "lstm_kernel_wins",
+                            lambda *a, **k: True)
         real = pk_mod.lstm_pallas_scan
         called = []
 
