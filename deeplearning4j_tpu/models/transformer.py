@@ -42,6 +42,7 @@ from deeplearning4j_tpu.parallel.mesh import (
     EXPERT_AXIS,
     MODEL_AXIS,
     PIPELINE_AXIS,
+    SEQUENCE_AXIS,
 )
 
 Params = Dict[str, Any]
@@ -189,12 +190,18 @@ def megatron_param_shardings(cfg: TransformerConfig, mesh: Mesh) -> Params:
 
 def param_shardings_for_mesh(cfg: TransformerConfig, mesh: Mesh) -> Params:
     """THE single place that decides a mesh's param layout: depth-sharded
-    (pipeline mode) when the mesh has a 'pipe' axis, Megatron/MoE GSPMD
-    specs otherwise. Training init, checkpoint restore and device_put all
-    route through here so they can never diverge."""
+    (pipeline mode) when the mesh has a 'pipe' axis; Megatron/MoE GSPMD
+    specs when it has a 'model'/'expert' axis; fully replicated otherwise
+    (sequence-parallel and pure-DP meshes — activations shard, params
+    don't). Training init, checkpoint restore and device_put all route
+    through here so they can never diverge."""
     if PIPELINE_AXIS in mesh.shape:
         return pipeline_param_shardings(cfg, mesh)
-    return megatron_param_shardings(cfg, mesh)
+    if MODEL_AXIS in mesh.shape or EXPERT_AXIS in mesh.shape:
+        return megatron_param_shardings(cfg, mesh)
+    rep = NamedSharding(mesh, P())
+    shapes = jax.eval_shape(partial(init_params, cfg))
+    return jax.tree_util.tree_map(lambda _: rep, shapes)
 
 
 def shard_params_for_mesh(params: Params, cfg: TransformerConfig,
@@ -344,6 +351,17 @@ def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
     return new, {"m": m, "v": v, "t": t}
 
 
+def _reject_bf16_policy(cfg: TransformerConfig, mode: str) -> None:
+    """The ring/pipeline block body (_dense_block_f32) computes in f32 by
+    design; a 'performance' policy would be SILENTLY ignored there — refuse
+    instead, so the user knows these modes are f32-only today."""
+    if cfg.dtype_policy == "performance":
+        raise NotImplementedError(
+            f"{mode} training runs the f32 block body (_dense_block_f32); "
+            "dtype_policy='performance' (bf16 compute) is not plumbed "
+            "through it yet — use dtype_policy='strict' on this mesh")
+
+
 def _validate_schedule(cfg: TransformerConfig) -> None:
     """Shared by the dense AND pipelined step factories — a cfg the dense
     path rejects loudly must never train silently through the pipeline."""
@@ -413,9 +431,13 @@ def _build_step(cfg: TransformerConfig):
 
 
 def _mesh_shardings(cfg: TransformerConfig, mesh: Mesh):
-    pshard = megatron_param_shardings(cfg, mesh)
+    # param_shardings_for_mesh handles every mesh kind (Megatron when a
+    # 'model'/'expert' axis exists, replicated for pure-DP meshes) — a
+    # ('data',)-only mesh must not crash on a 'model' PartitionSpec
+    pshard = param_shardings_for_mesh(cfg, mesh)
     oshard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
-    dshard = NamedSharding(mesh, P(DATA_AXIS))
+    dshard = NamedSharding(
+        mesh, P(DATA_AXIS) if DATA_AXIS in mesh.shape else P())
     return pshard, oshard, dshard
 
 
@@ -488,10 +510,15 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
                    else ulysses_attention_sharded)
     n, t = tokens.shape
     hd = cfg.d_model // cfg.n_heads
+    # DP x SP composition: shard the batch over 'data' inside the attention
+    # shard_map too — otherwise every data slice would all-gather the batch
+    # and compute the full attention redundantly
+    batch_ax = DATA_AXIS if DATA_AXIS in mesh.shape else None
 
     def attend(q, k, v):
         split = lambda a: a.reshape(n, t, cfg.n_heads, hd)
-        out = sharded_att(split(q), split(k), split(v), mesh, causal=True)
+        out = sharded_att(split(q), split(k), split(v), mesh, causal=True,
+                          batch_axis=batch_ax)
         return out.reshape(n, t, cfg.d_model)
 
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
@@ -503,6 +530,83 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
         h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn)
     h = _ln(h, params["lnf_g"], params["lnf_b"])
     return h @ params["embed"].T
+
+
+# ---------------------------------------------------------------------------
+# Sequence-parallel TRAINING (ring/Ulysses attention + loss + Adam in one
+# jitted step over a ('seq',) or ('data', 'seq') mesh)
+# ---------------------------------------------------------------------------
+
+
+def make_ring_train_step(cfg: TransformerConfig, mesh: Mesh, *,
+                         strategy: str = "ring"):
+    """Long-context TRAINING step: the forward's attention runs
+    sequence-parallel over the mesh's 'seq' axis (ring ppermute schedule or
+    Ulysses all-to-alls — parallel/sequence_parallel.py), everything else
+    (LN/FFN/embedding, elementwise over T) is sharded by GSPMD from the
+    token sharding, and autodiff transposes the ring into the backward
+    collective schedule. Params stay replicated; tokens/targets are
+    sharded [batch -> 'data' when present, T -> 'seq'].
+
+    This closes the axis that previously stopped at forward/eval
+    (ring_forward's docstring said inference/eval): sequences longer than
+    one chip's activation memory now take REAL optimizer steps.
+    SP-train == serial-train is locked by tests/test_ring_training.py."""
+    if cfg.moe_experts:
+        raise NotImplementedError(
+            "sequence-parallel training supports dense FFN blocks (the MoE "
+            "aux loss is dropped by the ring forward path)")
+    if cfg.accum_steps != 1:
+        raise ValueError("cfg.accum_steps must be 1 under sequence-parallel "
+                         "training (shard 'data' for more batch instead)")
+    _reject_bf16_policy(cfg, "sequence-parallel")
+    _validate_schedule(cfg)
+    (ins, outs) = _ring_step_shardings(cfg, mesh)
+    return jax.jit(_build_ring_step(cfg, mesh, strategy),
+                   in_shardings=ins, out_shardings=outs)
+
+
+def _build_ring_step(cfg, mesh, strategy):
+    def sp_loss(params, tokens, targets):
+        logits = ring_forward(params, tokens, cfg, mesh, strategy=strategy)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+
+    def step(params, opt, tokens, targets):
+        loss, grads = jax.value_and_grad(sp_loss)(params, tokens, targets)
+        lr = _scheduled_lr(cfg, opt["t"] + 1)
+        params, opt = _adam_update(params, grads, opt, lr)
+        return params, opt, loss
+
+    return step
+
+
+def _ring_step_shardings(cfg, mesh):
+    rep = NamedSharding(mesh, P())
+    # the SAME layout decision as __init__/restore (param_shardings_for_mesh:
+    # replicated on pure seq/data meshes, Megatron if the mesh also has a
+    # 'model'/'expert' axis) — step and placement can never disagree
+    pshard = param_shardings_for_mesh(cfg, mesh)
+    oshard = {"m": pshard, "v": pshard, "t": rep}
+    data_ax = DATA_AXIS if DATA_AXIS in mesh.shape else None
+    dshard = NamedSharding(mesh, P(data_ax, SEQUENCE_AXIS))
+    return ((pshard, oshard, dshard, dshard), (pshard, oshard, rep))
+
+
+def make_ring_train_multi_step(cfg: TransformerConfig, mesh: Mesh, *,
+                               strategy: str = "ring"):
+    """K sequence-parallel optimizer steps fused into one XLA program
+    (stacked batches [K, N, T] — fit_batches dispatch amortization for the
+    long-context mode)."""
+    step = _build_ring_step(cfg, mesh, strategy)
+    (pshard, oshard, dshard, _), (_, _, rep) = _ring_step_shardings(cfg,
+                                                                    mesh)
+    kshard = NamedSharding(mesh, P(None, *dshard.spec))
+    return jax.jit(
+        _multi_from_step(step),
+        in_shardings=(pshard, oshard, kshard, kshard),
+        out_shardings=(pshard, oshard, rep),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -608,6 +712,7 @@ def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
     # validated HERE so every pipelined factory (single- and multi-step)
     # rejects the unsupported configs, not just make_pipeline_train_step
     _validate_schedule(cfg)
+    _reject_bf16_policy(cfg, "pipelined")
     if cfg.moe_experts:
         raise NotImplementedError(
             "pipelined training supports dense FFN blocks (MoE routing is "
@@ -713,10 +818,15 @@ class TransformerLM:
                           else None),
         }
 
+    def _sequence_mode(self) -> bool:
+        return self.mesh is not None and SEQUENCE_AXIS in self.mesh.shape
+
     def _make_step(self):
         if self._pipeline_mode():
             return make_pipeline_train_step(self._run_cfg, self.mesh,
                                             **self._pipeline_kwargs())
+        if self._sequence_mode():
+            return make_ring_train_step(self._run_cfg, self.mesh)
         return make_train_step(self._run_cfg, self.mesh)
 
     @classmethod
@@ -755,6 +865,9 @@ class TransformerLM:
             if self._pipeline_mode():
                 self._multi_step = make_pipeline_train_multi_step(
                     self._run_cfg, self.mesh, **self._pipeline_kwargs())
+            elif self._sequence_mode():
+                self._multi_step = make_ring_train_multi_step(
+                    self._run_cfg, self.mesh)
             else:
                 self._multi_step = make_train_multi_step(self._run_cfg,
                                                          self.mesh)

@@ -58,18 +58,29 @@ def measured_win(group: str, name: str, *, min_speedup: float = 1.0,
     return float(row["speedup"]) >= min_speedup
 
 
-def record_win(group: str, name: str, row: dict) -> None:
-    """Merge one bench result into PALLAS_BENCH.json (atomic rewrite),
-    preserving unrelated groups/rows."""
+def _merge(mutate) -> None:
+    """Atomic read-mutate-replace of the artifact under the module lock."""
     with _lock:
         try:
             with open(_ARTIFACT) as f:
                 data = json.load(f)
         except (OSError, ValueError):
             data = {}
-        data.setdefault(group, {})[name] = row
+        mutate(data)
         tmp = _ARTIFACT + ".tmp"
         with open(tmp, "w") as f:
             json.dump(data, f, indent=1, sort_keys=True)
         os.replace(tmp, _ARTIFACT)
     reload()
+
+
+def record_win(group: str, name: str, row: dict) -> None:
+    """Merge one bench result into PALLAS_BENCH.json, preserving unrelated
+    groups/rows."""
+    _merge(lambda data: data.setdefault(group, {}).__setitem__(name, row))
+
+
+def merge_top_level(updates: dict) -> None:
+    """Merge top-level keys (the legacy round-1/2 schema: backend / cases /
+    verdict) into the artifact without touching kernel groups."""
+    _merge(lambda data: data.update(updates))

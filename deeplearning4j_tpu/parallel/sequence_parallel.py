@@ -191,14 +191,18 @@ def _ring_attention_body_flash(q, k, v, key_mask=None, *, causal: bool,
 
 def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False,
                            key_mask=None, use_flash: Optional[bool] = None,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           batch_axis: Optional[str] = None):
     """Full exact attention with the SEQUENCE dimension sharded over
     mesh axis 'seq'. q,k,v: [N, T, H, D] with T divisible by the axis size.
     key_mask: optional [N, T] 0/1, sharded with the keys (padded timesteps
     excluded exactly — the mask shard rotates with its K/V block).
     use_flash: run the local block product through the pallas flash kernel
     (ops/pallas_attention.py); default auto — on when pallas is enabled and
-    the local shard fits the kernel's block/VMEM constraints."""
+    the local shard fits the kernel's block/VMEM constraints.
+    batch_axis: optional second mesh axis sharding the BATCH dim (DP x SP
+    composition) — without it a ('data','seq') caller would all-gather the
+    batch and compute every data slice's attention redundantly."""
     from deeplearning4j_tpu.ops.pallas_attention import (
         ext_fits,
         pallas_enabled,
@@ -229,12 +233,12 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False,
     kwargs = dict(causal=causal, t_local=t_local)
     if use_flash:
         kwargs["interpret"] = interpret
-    spec = P(None, SEQ_AXIS, None, None)
+    spec = P(batch_axis, SEQ_AXIS, None, None)
     args = (q, k, v)
     in_specs = (spec, spec, spec)
     if key_mask is not None:
         args += (key_mask,)
-        in_specs += (P(None, SEQ_AXIS),)
+        in_specs += (P(batch_axis, SEQ_AXIS),)
     fn = shard_map(
         partial(body, **kwargs),
         mesh=mesh,
@@ -267,10 +271,12 @@ def _ulysses_body(q, k, v, *, causal: bool, axis_name: str = SEQ_AXIS):
                           tiled=True)
 
 
-def ulysses_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False):
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False,
+                              batch_axis: Optional[str] = None):
     """Exact full attention with the sequence dim sharded over mesh axis
     'seq' via head<->sequence all_to_alls (DeepSpeed-Ulysses strategy).
-    q,k,v: [N, T, H, D]; T and H must both divide by the axis size."""
+    q,k,v: [N, T, H, D]; T and H must both divide by the axis size.
+    batch_axis: optional second mesh axis sharding the batch (DP x SP)."""
     n_dev = mesh.shape[SEQ_AXIS]
     t, h = q.shape[1], q.shape[2]
     if t % n_dev != 0:
@@ -278,7 +284,7 @@ def ulysses_attention_sharded(q, k, v, mesh: Mesh, *, causal: bool = False):
     if h % n_dev != 0:
         raise ValueError(f"num heads {h} not divisible by {n_dev} devices "
                          "(Ulysses shards heads; use ring attention instead)")
-    spec = P(None, SEQ_AXIS, None, None)
+    spec = P(batch_axis, SEQ_AXIS, None, None)
     fn = shard_map(
         partial(_ulysses_body, causal=causal),
         mesh=mesh,
