@@ -362,6 +362,18 @@ def _reject_bf16_policy(cfg: TransformerConfig, mode: str) -> None:
             "through it yet — use dtype_policy='strict' on this mesh")
 
 
+def _donation_kwargs():
+    """Donate the OPT buffers (Adam m/v — 2/3 of the training-state HBM)
+    to the step: the moment updates become in-place on device. Params are
+    deliberately NOT donated — the repo's serial-vs-distributed equivalence
+    pattern passes one initial params tree to several step functions
+    (tests, dryrun legs), which donation would poison on real chips.
+    Optimizer state is always built fresh per run (init_opt_state), so its
+    donation is safe by construction. CPU backends skip donation entirely
+    (jax ignores it there with a warning per compile)."""
+    return {"donate_argnums": (1,)} if jax.default_backend() != "cpu" else {}
+
+
 def _validate_schedule(cfg: TransformerConfig) -> None:
     """Shared by the dense AND pipelined step factories — a cfg the dense
     path rejects loudly must never train silently through the pipeline."""
@@ -457,12 +469,13 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     so microbatching would silently change the objective."""
     step = _build_step(cfg)
     if mesh is None:
-        return jax.jit(step)
+        return jax.jit(step, **_donation_kwargs())
     pshard, oshard, dshard = _mesh_shardings(cfg, mesh)
     return jax.jit(
         step,
         in_shardings=(pshard, oshard, dshard, dshard),
         out_shardings=(pshard, oshard, NamedSharding(mesh, P())),
+        **_donation_kwargs(),
     )
 
 
@@ -476,13 +489,14 @@ def make_train_multi_step(cfg: TransformerConfig,
     step = _build_step(cfg)
     multi = _multi_from_step(step)
     if mesh is None:
-        return jax.jit(multi)
+        return jax.jit(multi, **_donation_kwargs())
     pshard, oshard, dshard = _mesh_shardings(cfg, mesh)
     kshard = NamedSharding(mesh, P(None, DATA_AXIS))  # [K, N, T]
     return jax.jit(
         multi,
         in_shardings=(pshard, oshard, kshard, kshard),
         out_shardings=(pshard, oshard, NamedSharding(mesh, P())),
+        **_donation_kwargs(),
     )
 
 
@@ -552,6 +566,15 @@ def make_ring_train_step(cfg: TransformerConfig, mesh: Mesh, *,
     (ring_forward's docstring said inference/eval): sequences longer than
     one chip's activation memory now take REAL optimizer steps.
     SP-train == serial-train is locked by tests/test_ring_training.py."""
+    (ins, outs) = _ring_step_shardings(cfg, mesh)
+    return jax.jit(_build_ring_step(cfg, mesh, strategy),
+                   in_shardings=ins, out_shardings=outs,
+                   **_donation_kwargs())
+
+
+def _build_ring_step(cfg, mesh, strategy):
+    # validated HERE so every sequence-parallel factory (single- and
+    # multi-step) rejects the unsupported configs
     if cfg.moe_experts:
         raise NotImplementedError(
             "sequence-parallel training supports dense FFN blocks (the MoE "
@@ -561,12 +584,7 @@ def make_ring_train_step(cfg: TransformerConfig, mesh: Mesh, *,
                          "training (shard 'data' for more batch instead)")
     _reject_bf16_policy(cfg, "sequence-parallel")
     _validate_schedule(cfg)
-    (ins, outs) = _ring_step_shardings(cfg, mesh)
-    return jax.jit(_build_ring_step(cfg, mesh, strategy),
-                   in_shardings=ins, out_shardings=outs)
 
-
-def _build_ring_step(cfg, mesh, strategy):
     def sp_loss(params, tokens, targets):
         logits = ring_forward(params, tokens, cfg, mesh, strategy=strategy)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -606,6 +624,7 @@ def make_ring_train_multi_step(cfg: TransformerConfig, mesh: Mesh, *,
         _multi_from_step(step),
         in_shardings=(pshard, oshard, kshard, kshard),
         out_shardings=(pshard, oshard, rep),
+        **_donation_kwargs(),
     )
 
 
@@ -705,7 +724,8 @@ def make_pipeline_train_step(cfg: TransformerConfig, mesh: Mesh, *,
     chip's HBM while still taking real optimizer steps."""
     ins, outs = _pipeline_step_shardings(cfg, mesh, axis, data_axis)
     return jax.jit(_build_pipeline_step(cfg, mesh, n_micro, axis, data_axis),
-                   in_shardings=ins, out_shardings=outs)
+                   in_shardings=ins, out_shardings=outs,
+                   **_donation_kwargs())
 
 
 def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
@@ -762,6 +782,7 @@ def make_pipeline_train_multi_step(cfg: TransformerConfig, mesh: Mesh, *,
         _multi_from_step(step),
         in_shardings=(pshard, oshard, kshard, kshard),
         out_shardings=(pshard, oshard, lshard),
+        **_donation_kwargs(),
     )
 
 

@@ -91,6 +91,22 @@ class TestRingTrainStep:
         with pytest.raises(NotImplementedError):
             make_ring_train_step(cfg, mesh)
 
+    def test_multi_step_factory_validates_too(self):
+        """Guards live in the shared builder: the multi-step factory must
+        reject the same configs as the single-step one."""
+        from deeplearning4j_tpu.models.transformer import (
+            make_ring_train_multi_step,
+        )
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        with pytest.raises(NotImplementedError):
+            make_ring_train_multi_step(_cfg(moe_experts=4, d_ff=32), mesh)
+        with pytest.raises(ValueError):
+            make_ring_train_multi_step(_cfg(accum_steps=2), mesh)
+        with pytest.raises(NotImplementedError):
+            make_ring_train_multi_step(_cfg(dtype_policy="performance"),
+                                       mesh)
+
 
 class TestTransformerLMSequenceMode:
     def test_lm_on_seq_mesh_trains_and_matches_serial(self):
