@@ -241,24 +241,30 @@ def _attention(q, k, v, n_heads, use_flash=False):
     return jnp.einsum("nhqk,nkhd->nqhd", p, v).reshape(n, t, d)
 
 
-def _dense_block_f32(bp, h, n_heads: int, attend=None, ffn=None):
-    """One transformer block in plain f32 (no flash, no casts) — the block
-    body shared by the sequence-parallel (ring_forward) and
-    pipeline-parallel (pipeline_forward) paths; forward() keeps its own
-    cast-aware variant for the mixed-precision/flash path. `attend`
-    overrides the attention op ((q, k, v) [N,T,F] -> [N,T,F]) so the ring/
-    Ulysses strategies plug in; `ffn` overrides the feed-forward
-    (x_normed -> residual delta) so the MoE branch shares the
-    attention-residual half too."""
+def _dense_block_f32(bp, h, n_heads: int, attend=None, ffn=None,
+                     cdt=jnp.float32):
+    """One transformer block (no flash) — the block body shared by the
+    sequence-parallel (ring_forward) and pipeline-parallel
+    (pipeline_forward) paths; forward() keeps its own cast-aware variant
+    for the mixed-precision/flash path. cdt: compute dtype — f32 by
+    default (the name records the original scope); bf16 under
+    dtype_policy='performance' (params cast per use like forward(), the
+    residual stream h carried in cdt — which also halves the ring/pipe
+    ppermute traffic). `attend` overrides the attention op
+    ((q, k, v) [N,T,F] -> [N,T,F]) so the ring/Ulysses strategies plug
+    in; `ffn` overrides the feed-forward (x_normed -> residual delta) so
+    the MoE branch shares the attention-residual half too."""
+    c = lambda a: a.astype(cdt)
     if attend is None:
         attend = lambda q, k, v: _attention(q, k, v, n_heads)
-    x = _ln(h, bp["ln1_g"], bp["ln1_b"])
-    q, k, v = x @ bp["Wq"], x @ bp["Wk"], x @ bp["Wv"]
-    h = h + attend(q, k, v) @ bp["Wo"]
-    x = _ln(h, bp["ln2_g"], bp["ln2_b"])
+    x = _ln(h, c(bp["ln1_g"]), c(bp["ln1_b"]))
+    q, k, v = x @ c(bp["Wq"]), x @ c(bp["Wk"]), x @ c(bp["Wv"])
+    h = h + attend(q, k, v) @ c(bp["Wo"])
+    x = _ln(h, c(bp["ln2_g"]), c(bp["ln2_b"]))
     if ffn is not None:
         return h + ffn(x)
-    return h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] + bp["b2"]
+    return (h + jax.nn.gelu(x @ c(bp["W1"]) + c(bp["b1"])) @ c(bp["W2"])
+            + c(bp["b2"]))
 
 
 def _moe_ffn(bp, h, cfg: TransformerConfig):
@@ -351,17 +357,6 @@ def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
     return new, {"m": m, "v": v, "t": t}
 
 
-def _reject_bf16_policy(cfg: TransformerConfig, mode: str) -> None:
-    """The ring/pipeline block body (_dense_block_f32) computes in f32 by
-    design; a 'performance' policy would be SILENTLY ignored there — refuse
-    instead, so the user knows these modes are f32-only today."""
-    if cfg.dtype_policy == "performance":
-        raise NotImplementedError(
-            f"{mode} training runs the f32 block body (_dense_block_f32); "
-            "dtype_policy='performance' (bf16 compute) is not plumbed "
-            "through it yet — use dtype_policy='strict' on this mesh")
-
-
 def _donation_kwargs():
     """Donate the OPT buffers (Adam m/v — 2/3 of the training-state HBM)
     to the step: the moment updates become in-place on device. Params are
@@ -369,9 +364,16 @@ def _donation_kwargs():
     pattern passes one initial params tree to several step functions
     (tests, dryrun legs), which donation would poison on real chips.
     Optimizer state is always built fresh per run (init_opt_state), so its
-    donation is safe by construction. CPU backends skip donation entirely
-    (jax ignores it there with a warning per compile)."""
-    return {"donate_argnums": (1,)} if jax.default_backend() != "cpu" else {}
+    donation is safe by construction. CPU platforms skip donation (jax
+    ignores it there with a warning per compile). The decision reads the
+    jax_platforms CONFIG, never the backend — jax.default_backend() would
+    initialize the axon plugin at factory-construction time, which hangs
+    on a dead tunnel (CLAUDE.md) and locks the platform before the caller
+    could still choose CPU."""
+    platforms = jax.config.jax_platforms
+    if platforms and platforms.split(",")[0] == "cpu":
+        return {}
+    return {"donate_argnums": (1,)}
 
 
 def _validate_schedule(cfg: TransformerConfig) -> None:
@@ -535,15 +537,20 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
                           batch_axis=batch_ax)
         return out.reshape(n, t, cfg.d_model)
 
-    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
+    cdt = cfg.compute_dtype
+    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(cdt)
     L = params["blocks"]["Wq"].shape[0]
     for i in range(L):
         bp = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
-        ffn = ((lambda x, bp=bp: _moe_ffn(bp, x, cfg)[0])
-               if cfg.moe_experts else None)
-        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn)
-    h = _ln(h, params["lnf_g"], params["lnf_b"])
-    return h @ params["embed"].T
+        if cfg.moe_experts:
+            bp16 = {kk: vv.astype(cdt) for kk, vv in bp.items()}
+            ffn = lambda x, bp16=bp16: _moe_ffn(bp16, x, cfg)[0]
+        else:
+            ffn = None
+        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn,
+                             cdt=cdt)
+    h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return (h @ params["embed"].T).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -582,7 +589,6 @@ def _build_ring_step(cfg, mesh, strategy):
     if cfg.accum_steps != 1:
         raise ValueError("cfg.accum_steps must be 1 under sequence-parallel "
                          "training (shard 'data' for more batch instead)")
-    _reject_bf16_policy(cfg, "sequence-parallel")
     _validate_schedule(cfg)
 
     def sp_loss(params, tokens, targets):
@@ -658,19 +664,23 @@ def pipeline_forward(params: Params, tokens: jax.Array,
     stage_params = jax.tree_util.tree_map(
         lambda a: a.reshape((n_stages, per) + a.shape[1:]), params["blocks"])
 
+    cdt = cfg.compute_dtype
+
     def stage_fn(sp, h):
         def block(h, bp):
-            return _dense_block_f32(bp, h, cfg.n_heads), None
+            return _dense_block_f32(bp, h, cfg.n_heads, cdt=cdt), None
 
         h, _ = lax.scan(block, h, sp)
         return h
 
     n, t = tokens.shape
-    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(jnp.float32)
+    # bf16 policy: the residual stream (the thing the ring ppermutes each
+    # tick) is carried in the compute dtype — half the ICI traffic
+    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(cdt)
     h = pipeline_apply(stage_params, h, mesh, stage_fn=stage_fn,
                        n_micro=n_micro, axis=axis, data_axis=data_axis)
-    h = _ln(h, params["lnf_g"], params["lnf_b"])
-    return h @ params["embed"].T
+    h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return (h @ params["embed"].T).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -732,7 +742,6 @@ def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
     # validated HERE so every pipelined factory (single- and multi-step)
     # rejects the unsupported configs, not just make_pipeline_train_step
     _validate_schedule(cfg)
-    _reject_bf16_policy(cfg, "pipelined")
     if cfg.moe_experts:
         raise NotImplementedError(
             "pipelined training supports dense FFN blocks (MoE routing is "

@@ -54,6 +54,10 @@ def multi_head_attention(q, k, v, *, causal: bool = False,
     softmax (variable-length batches)."""
     d = q.shape[-1]
     s = jnp.einsum("nqhd,nkhd->nhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
+    # softmax in f32 regardless of compute dtype: a bf16 exp/sum over
+    # thousands of keys loses mass (every other attention path — serial
+    # _attention, the ring body, the flash kernel — already upcasts)
+    s = s.astype(jnp.float32)
     if causal:
         qi = q_offset + jnp.arange(q.shape[1])
         ki = k_offset + jnp.arange(k.shape[1])
@@ -65,7 +69,7 @@ def multi_head_attention(q, k, v, *, causal: bool = False,
     p = jax.nn.softmax(s, axis=-1)
     # fully-masked rows (causal shard with no visible keys) -> zeros not NaN
     p = jnp.where(jnp.isfinite(s).any(axis=-1, keepdims=True), p, 0.0)
-    return jnp.einsum("nhqk,nkhd->nqhd", p, v)
+    return jnp.einsum("nhqk,nkhd->nqhd", p.astype(q.dtype), v)
 
 
 # ---------------------------------------------------------------------------

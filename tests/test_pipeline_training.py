@@ -103,6 +103,24 @@ class TestPipelineTrainStep:
         with pytest.raises(NotImplementedError):
             make_pipeline_train_step(cfg, mesh, n_micro=4)
 
+    def test_bf16_policy_trains_close_to_serial(self):
+        """dtype_policy='performance' carries the residual stream through
+        the GPipe ppermutes in bf16; tolerance bar vs the serial bf16
+        path (rounding orders differ)."""
+        cfg = _cfg(dtype_policy="performance", learning_rate=1e-2)
+        xs, ys = _batches(cfg)
+        params = init_params(cfg)
+
+        serial = make_train_step(cfg)
+        _, _, curve_s = _run_curve(serial, params, init_opt_state(params),
+                                   xs, ys)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("pipe",))
+        pp_step = make_pipeline_train_step(cfg, mesh, n_micro=4)
+        p_p = shard_params_pipeline(params, cfg, mesh)
+        _, _, curve_p = _run_curve(pp_step, p_p, init_opt_state(p_p), xs, ys)
+        np.testing.assert_allclose(curve_p, curve_s, rtol=5e-2)
+        assert all(np.isfinite(curve_p))
+
 
 class TestTransformerLMPipelineMode:
     def test_lm_on_pipe_mesh_trains_and_matches_serial(self):
