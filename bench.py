@@ -540,15 +540,7 @@ def bench_flash_attention(n=4, t=2048, h=8, d=64, steps=10):
 def bench_word2vec(vocab=2000, sentences=800, sent_len=40):
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
-    rng = np.random.default_rng(0)
-    # zipf-ish corpus over a synthetic vocab
-    probs = 1.0 / np.arange(1, vocab + 1)
-    probs /= probs.sum()
-    words = [f"w{i}" for i in range(vocab)]
-    corpus = [
-        [words[i] for i in rng.choice(vocab, size=sent_len, p=probs)]
-        for _ in range(sentences)
-    ]
+    corpus, provenance = _w2v_corpus(vocab, sentences, sent_len)
     w2v = Word2Vec(layer_size=128, window=5, negative=5, min_word_frequency=1,
                    epochs=1, iterations=1, batch_size=2048, seed=1)
     w2v.build_vocab(corpus)
@@ -564,8 +556,43 @@ def bench_word2vec(vocab=2000, sentences=800, sent_len=40):
     return {
         "pairs_per_sec": round(pairs / warm_dt, 1),
         "pairs_per_sec_incl_compile": round(pairs / cold_dt, 1),
-        "pairs": int(pairs), "vocab": vocab,
+        "pairs": int(pairs), "vocab": int(len(w2v.vocab)),
+        "data": provenance,
     }
+
+
+def _w2v_corpus(vocab, sentences, sent_len):
+    """Bench corpus: a REAL local text file when DL4J_TPU_W2V_CORPUS
+    points at one (tokenized by the framework tokenizer, provenance
+    'local' — this zero-egress host cannot download text8), else the
+    deterministic zipf-ish synthetic corpus, labeled as such."""
+    path = os.environ.get("DL4J_TPU_W2V_CORPUS")
+    if path and os.path.isfile(path):
+        from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+
+        tf = DefaultTokenizerFactory()
+        corpus, line_count = [], 0
+        with open(path, errors="ignore") as f:
+            for line in f:
+                toks = tf.create(line).get_tokens()
+                if len(toks) >= 5:
+                    corpus.append(toks[:512])
+                    line_count += 1
+                if line_count >= sentences * 4:
+                    break
+        if corpus:
+            return corpus, f"local:{os.path.basename(path)}"
+        _log(f"W2V corpus {path} yielded no usable lines; falling back")
+    rng = np.random.default_rng(0)
+    # zipf-ish corpus over a synthetic vocab
+    probs = 1.0 / np.arange(1, vocab + 1)
+    probs /= probs.sum()
+    words = [f"w{i}" for i in range(vocab)]
+    return (
+        [[words[i] for i in rng.choice(vocab, size=sent_len, p=probs)]
+         for _ in range(sentences)],
+        "synthetic",
+    )
 
 
 # ---------------------------------------------------------------------------

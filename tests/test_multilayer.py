@@ -8,6 +8,7 @@ import pytest
 from deeplearning4j_tpu.datasets.fetchers import IrisDataSetIterator, load_iris
 from deeplearning4j_tpu.datasets.iterator import (
     AsyncDataSetIterator,
+    DataSet,
     ListDataSetIterator,
 )
 from deeplearning4j_tpu.nn.conf import (
@@ -513,3 +514,35 @@ def test_performance_policy_bn_and_lstm_state_dtypes():
     assert np.isfinite(loss)
     assert net_bn.states[1]["mean"].dtype == jnp.float32
     assert net_bn.states[1]["var"].dtype == jnp.float32
+
+
+def test_fused_fit_iterator_equals_per_step():
+    """fit_iterator(fused_batches=K) stacks K DataSets into one
+    fit_batches program; parameters must match the per-step loop exactly
+    (fit_batches is serially equivalent), including the ragged tail."""
+    x, y = load_iris()
+    x, y = x[:130], y[:130]  # 5 batches of 26: K=2 leaves a tail of 1
+    a = iris_net(seed=9)
+    b = iris_net(seed=9)
+    a.fit_iterator(ListDataSetIterator(x, y, batch=26), num_epochs=2)
+    b.fit_iterator(ListDataSetIterator(x, y, batch=26), num_epochs=2,
+                   fused_batches=2)
+    for p1, p2 in zip(a.params, b.params):
+        for k in p1:
+            np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                       rtol=1e-6, atol=1e-7)
+    assert a.iteration == b.iteration
+
+
+def test_fused_fit_iterator_shape_change_falls_back():
+    """A shape change mid-stream flushes the buffer per-step instead of
+    crashing the stack."""
+    x, y = load_iris()
+    ds_list = [
+        DataSet(x[:32], y[:32]), DataSet(x[32:64], y[32:64]),
+        DataSet(x[64:80], y[64:80]),  # different batch size
+        DataSet(x[80:96], y[80:96]),
+    ]
+    net = iris_net(seed=11)
+    net.fit_iterator(ds_list, fused_batches=2)
+    assert net.iteration == 4
