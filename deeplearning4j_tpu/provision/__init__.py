@@ -1,0 +1,17 @@
+"""Cluster provisioning — the TPU-native reading of deeplearning4j-aws.
+
+Reference: deeplearning4j-scaleout/deeplearning4j-aws/.../ec2/provision/
+ClusterSetup.java:39 (EC2 boxes + setup scripts), HostProvisioner.java
+(SSH/SCP fan-out), DistributedDeepLearningTrainer.java, s3/ (bucket
+dataset IO). The TPU equivalent provisions a TPU pod slice with gcloud,
+fans the bootstrap out over `gcloud compute tpus tpu-vm ssh --worker=all`,
+and wires every host's jax.distributed coordinator env
+(parallel/multihost.py MultiHostConfig) — see provision/tpu_pod.py and
+provision/gcs.py.
+"""
+
+from deeplearning4j_tpu.provision.tpu_pod import (  # noqa: F401
+    ClusterSetup,
+    TpuPodSpec,
+)
+from deeplearning4j_tpu.provision.gcs import GcsDataSetLoader  # noqa: F401

@@ -1,0 +1,135 @@
+"""Cloud-bucket dataset IO — the s3/ package's role on GCS.
+
+Reference: deeplearning4j-aws/.../s3/reader/{S3Downloader,BucketIterator,
+BaseS3DataSetIterator}.java + uploader/S3Uploader.java + dataset/
+DataSetLoader.java: stream bucket objects into DataSets / upload model
+artifacts. TPU-native reading: the bucket is gs:// and the transfer tool
+is gsutil (runner-injected, like provision/tpu_pod.py, so the zero-egress
+test environment exercises listing/downloading/uploading logic against a
+fake runner); downloaded npz/csv payloads feed the SAME record readers
+the local pipeline uses (datasets/records.py) — no separate parse path.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from dataclasses import dataclass
+from typing import Callable, Iterator, List, Optional
+
+Runner = Callable[[List[str]], "subprocess.CompletedProcess"]
+
+
+def _default_runner(cmd: List[str]):
+    return subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _require_gs(uri: str) -> str:
+    if not uri.startswith("gs://"):
+        raise ValueError(f"expected a gs:// URI, got {uri!r}")
+    return uri
+
+
+@dataclass
+class BucketIterator:
+    """List a bucket prefix (reference BucketIterator.java): yields object
+    URIs via `gsutil ls`."""
+
+    prefix: str
+    runner: Runner = _default_runner
+
+    def __iter__(self) -> Iterator[str]:
+        out = self.runner(["gsutil", "ls", _require_gs(self.prefix)])
+        for line in (out.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("gs://") and not line.endswith("/"):
+                yield line
+
+
+@dataclass
+class GcsDownloader:
+    """reference S3Downloader.java: fetch objects to a local cache dir
+    (idempotent — existing files are not re-fetched)."""
+
+    cache_dir: str
+    runner: Runner = _default_runner
+
+    def fetch(self, uri: str) -> str:
+        _require_gs(uri)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        # cache key is the FULL object path (sanitized), not the basename —
+        # gs://b/train/shard0.npz and gs://b/eval/shard0.npz must never
+        # collide into one cache file
+        key = uri[len("gs://"):].replace("/", "__")
+        local = os.path.join(self.cache_dir, key)
+        if not os.path.exists(local):
+            self.runner(["gsutil", "cp", uri, local])
+        return local
+
+
+@dataclass
+class GcsUploader:
+    """reference S3Uploader.java: push a local artifact (model zip,
+    checkpoint dir) to the bucket. Directories use recursive copy (the
+    sharded-orbax checkpoint layout)."""
+
+    runner: Runner = _default_runner
+
+    def upload(self, local_path: str, uri: str) -> None:
+        _require_gs(uri)
+        cmd = ["gsutil", "cp", local_path, uri]
+        if os.path.isdir(local_path):
+            cmd = ["gsutil", "-m", "cp", "-r", local_path, uri]
+        self.runner(cmd)
+
+
+class GcsDataSetLoader:
+    """reference dataset/DataSetLoader.java + BaseS3DataSetIterator: walk a
+    bucket prefix, download each object, and parse it with the local
+    record-reading path (npz with 'features'/'labels' arrays, or csv with
+    the label in the last column — the CLI's formats)."""
+
+    def __init__(self, prefix: str, cache_dir: str,
+                 runner: Runner = _default_runner,
+                 batch_size: Optional[int] = None,
+                 num_classes: Optional[int] = None):
+        self.prefix = prefix
+        self.downloader = GcsDownloader(cache_dir, runner)
+        self.runner = runner
+        self.batch_size = batch_size
+        # CSV shards one-hot their integer labels to THIS width; inferring
+        # it per shard would give different shards different label shapes
+        self.num_classes = num_classes
+
+    def __iter__(self):
+        from deeplearning4j_tpu.datasets.iterator import DataSet
+
+        for uri in BucketIterator(self.prefix, self.runner):
+            local = self.downloader.fetch(uri)
+            x, y = self._parse(local, self.num_classes)
+            if self.batch_size is None:
+                yield DataSet(x, y)
+            else:
+                for i in range(0, len(x), self.batch_size):
+                    yield DataSet(x[i:i + self.batch_size],
+                                  y[i:i + self.batch_size])
+
+    @staticmethod
+    def _parse(path: str, num_classes: Optional[int]):
+        import numpy as np
+
+        if path.endswith(".npz"):
+            z = np.load(path)
+            return z["features"], z["labels"]
+        if path.endswith(".csv"):
+            if num_classes is None:
+                raise ValueError(
+                    "CSV shards need num_classes= on the loader — a "
+                    "per-shard labels.max() would give different shards "
+                    "different one-hot widths")
+            raw = np.loadtxt(path, delimiter=",", dtype=np.float32)
+            labels = raw[:, -1].astype(np.int64)
+            return (raw[:, :-1],
+                    np.eye(num_classes, dtype=np.float32)[labels])
+        raise ValueError(f"unsupported dataset object {path!r} "
+                         "(expected .npz or .csv)")

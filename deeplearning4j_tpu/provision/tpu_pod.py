@@ -1,0 +1,156 @@
+"""TPU pod-slice provisioning (ClusterSetup.java:39 role, gcloud edition).
+
+The reference provisions an EC2 master + N workers (Ec2BoxCreator), pushes
+setup scripts over SSH/SCP (HostProvisioner.java), and launches the
+distributed trainer (DistributedDeepLearningTrainer.java). On TPU the
+"cluster" is a pod slice: ONE gcloud resource whose hosts are addressed
+with `--worker=<i>|all`, and the service-discovery role (the reference's
+ZooKeeper) is jax.distributed's coordinator triple — which this module
+wires through the DL4J_TPU_* env vars that
+parallel/multihost.MultiHostConfig.from_env reads.
+
+Everything is PLAN-FIRST and runner-injected: `plan()` returns the exact
+gcloud invocations, `apply(runner=...)` executes them through a callable
+(subprocess by default), so the zero-egress test environment validates the
+full command/bootstrap/env generation without touching a cloud API — the
+same reason the reference's ClusterSetup is driven by args4j options
+rather than hardcoded infra.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+_HOSTS_PER_TYPE_DEFAULT = 8  # chips per host on current TPU generations
+
+
+@dataclass(frozen=True)
+class TpuPodSpec:
+    """The provisioning request (reference ClusterSetup options -w/-ami/-s
+    mapped to their TPU equivalents)."""
+
+    name: str
+    zone: str = "us-central2-b"
+    accelerator_type: str = "v5litepod-16"   # -s instance size role
+    runtime_version: str = "tpu-ubuntu2204-base"  # -ami role
+    project: Optional[str] = None
+    coordinator_port: int = 8476
+    chips_per_host: int = _HOSTS_PER_TYPE_DEFAULT
+
+    @property
+    def num_chips(self) -> int:
+        # accelerator types encode the chip count after the last '-'
+        try:
+            return int(self.accelerator_type.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            raise ValueError(
+                f"cannot infer chip count from accelerator_type "
+                f"{self.accelerator_type!r} (expected e.g. 'v5litepod-16')")
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+    def _gcloud(self, *args: str) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
+               f"--zone={self.zone}"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+
+def host_env(spec: TpuPodSpec, process_id: int,
+             coordinator_host: str = "$(hostname -i)") -> Dict[str, str]:
+    """The per-host jax.distributed env (MultiHostConfig.from_env contract;
+    the reference's ZooKeeperConfigurationRegister role): worker 0 is the
+    coordinator, every host learns the triple through env vars."""
+    return {
+        "DL4J_TPU_COORDINATOR": f"{coordinator_host}:{spec.coordinator_port}",
+        "DL4J_TPU_NUM_PROCESSES": str(spec.num_hosts),
+        "DL4J_TPU_PROCESS_ID": str(process_id),
+    }
+
+
+def bootstrap_script(spec: TpuPodSpec, repo_dir: str, train_cmd: str) -> str:
+    """The worker setup script (reference -wscript/-mscript roles unified:
+    a pod slice has no master/worker asymmetry — worker 0 merely also
+    hosts the coordinator). gcloud ssh --worker=all runs this on every
+    host. The coordinator address is resolved ON-HOST from the TPU
+    metadata environment (TPU_WORKER_HOSTNAMES lists every host, worker 0
+    first; TPU_WORKER_ID is this host's index) — no describe-output
+    parsing, and a single-host slice falls back to its own address."""
+    lines = [
+        "#!/bin/bash",
+        "set -euo pipefail",
+        f"cd {shlex.quote(repo_dir)}",
+        'PROC_ID="${TPU_WORKER_ID:-0}"',
+        # worker 0's hostname from the TPU metadata env; self for 1-host
+        'COORDINATOR_IP="$(echo "${TPU_WORKER_HOSTNAMES:-$(hostname -i)}" '
+        '| cut -d, -f1)"',
+        f'export DL4J_TPU_COORDINATOR='
+        f'"${{COORDINATOR_IP}}:{spec.coordinator_port}"',
+        f'export DL4J_TPU_NUM_PROCESSES={spec.num_hosts}',
+        'export DL4J_TPU_PROCESS_ID="${PROC_ID}"',
+        f"export PYTHONPATH={shlex.quote(repo_dir)}:${{PYTHONPATH:-}}",
+        # initialize_multihost() picks the triple up from the env
+        train_cmd,
+    ]
+    return "\n".join(lines) + "\n"
+
+
+Runner = Callable[[List[str]], "subprocess.CompletedProcess"]
+
+
+def _default_runner(cmd: List[str]):
+    return subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+@dataclass
+class ClusterSetup:
+    """Provision -> bootstrap -> launch, the reference ClusterSetup.exec()
+    sequence, plan-first. `apply` executes through an injected runner so
+    tests (and dry runs) never touch gcloud."""
+
+    spec: TpuPodSpec
+    repo_dir: str = "/opt/deeplearning4j_tpu"
+    train_cmd: str = ("python -m deeplearning4j_tpu.cli train "
+                      "--conf conf.json --input train.csv --output model.zip")
+    setup_cmds: List[str] = field(default_factory=list)
+
+    def plan(self) -> List[List[str]]:
+        """The exact gcloud invocations, in order: create the slice, read
+        back its state, push the bootstrap to every host, run it."""
+        s = self.spec
+        create = s._gcloud(
+            "create", s.name,
+            f"--accelerator-type={s.accelerator_type}",
+            f"--version={s.runtime_version}",
+        )
+        describe = s._gcloud("describe", s.name)
+        ssh_all = s._gcloud(
+            "ssh", s.name, "--worker=all",
+            f"--command={self._remote_command()}",
+        )
+        return [create, describe, ssh_all]
+
+    def _remote_command(self) -> str:
+        parts = list(self.setup_cmds)
+        parts.append(f"bash -s <<'DL4J_BOOTSTRAP'\n"
+                     f"{bootstrap_script(self.spec, self.repo_dir, self.train_cmd)}"
+                     f"DL4J_BOOTSTRAP")
+        return " && ".join(parts)
+
+    def teardown_plan(self) -> List[List[str]]:
+        return [self.spec._gcloud("delete", self.spec.name, "--quiet")]
+
+    def apply(self, runner: Runner = _default_runner) -> List:
+        """Execute the plan (reference exec(): provisionMaster +
+        provisionWorkers). Raises on the first failing command — the
+        reference's HostProvisioner logs and aborts the same way."""
+        return [runner(cmd) for cmd in self.plan()]
+
+    def teardown(self, runner: Runner = _default_runner) -> List:
+        return [runner(cmd) for cmd in self.teardown_plan()]
