@@ -414,7 +414,7 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
     }
 
 
-def bench_ring_attention(n=1, t=4096, h=8, d=64, steps=5):
+def bench_ring_attention(n=1, t=4096, h=8, d=64, steps=5, interpret=False):
     """Long-context ring attention: local block product through the pallas
     flash kernel (ops/pallas_attention.flash_attention_block) vs the einsum
     body, on a 1-device 'seq' mesh — the only ring THIS host can run (one
@@ -444,7 +444,8 @@ def bench_ring_attention(n=1, t=4096, h=8, d=64, steps=5):
                     "schedule equivalence is proven on the virtual mesh")}
     for name, uf in (("einsum", False), ("flash", True)):
         fn = jax.jit(lambda q, k, v, uf=uf: ring_attention_sharded(
-            q, k, v, mesh, causal=True, use_flash=uf))
+            q, k, v, mesh, causal=True, use_flash=uf,
+            interpret=interpret))
         o = fn(q, k, v)
         _force(o)
         t0 = time.perf_counter()

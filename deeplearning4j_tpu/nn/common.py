@@ -123,3 +123,32 @@ def decay_lr_scale_entry(state, rate: float):
     if isinstance(state, dict) and "lr_scale" in state:
         return {**state, "lr_scale": state["lr_scale"] * rate}
     return state
+
+
+def fused_iterator_loop(data, k: int, *, can_stack, same_shape, fit_one,
+                        fit_fused) -> None:
+    """ONE copy of the fused fit(DataSetIterator) buffering state machine,
+    shared by MultiLayerNetwork and ComputationGraph (their fit_iterator
+    fused_batches paths): buffer up to k stackable same-shape items, flush
+    through fit_fused; anything unstackable (or a ragged tail) drains
+    through fit_one. On a shape change the buffer drains and the NEW item
+    STARTS the next buffer (fusion continues within each shape group)."""
+    buf = []
+
+    def drain():
+        for d in buf:
+            fit_one(d)
+        buf.clear()
+
+    for ds in data:
+        if not can_stack(ds):
+            drain()
+            fit_one(ds)
+            continue
+        if buf and not same_shape(buf[0], ds):
+            drain()
+        buf.append(ds)
+        if len(buf) == k:
+            fit_fused(list(buf))
+            buf.clear()
+    drain()

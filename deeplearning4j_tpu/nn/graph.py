@@ -653,27 +653,78 @@ class ComputationGraph:
             self._record_iteration(loss)
         return loss
 
-    def fit_iterator(self, iterator, num_epochs: int = 1) -> "ComputationGraph":
+    def fit_iterator(self, iterator, num_epochs: int = 1,
+                     fused_batches: int = 1) -> "ComputationGraph":
         """fit over a MultiDataSetIterator (or DataSetIterator for
-        single-input/single-output graphs)."""
+        single-input/single-output graphs).
+
+        fused_batches=K > 1: stack K consecutive same-shape mask-free
+        DataSets/MultiDataSets through fit_batches (one XLA program per K
+        optimizer steps — MultiLayerNetwork.fit_iterator's fused path for
+        the DAG container). Per-step fallback for masks, shape changes,
+        ragged tails, TBPTT and non-SGD solvers."""
         if self.params is None:
             self.init()
+        fused = (fused_batches > 1
+                 and self.conf.backprop_type != "truncated_bptt"
+                 and self.conf.optimization_algo
+                 == "stochastic_gradient_descent")
+        from deeplearning4j_tpu.nn.common import fused_iterator_loop
+
         for _ in range(num_epochs):
-            for ds in iterator:
-                if hasattr(ds, "features_list"):  # MultiDataSet
-                    self.fit(
-                        ds.features_list,
-                        ds.labels_list,
-                        ds.features_masks,
-                        ds.labels_masks,
-                    )
-                else:  # single-input/single-output DataSet
-                    self.fit(
-                        ds.features, ds.labels, ds.features_mask, ds.labels_mask
-                    )
+            if not fused:
+                for ds in iterator:
+                    self._fit_ds(ds)
+            else:
+                fused_iterator_loop(
+                    iterator, fused_batches,
+                    can_stack=self._graph_stackable,  # fit_batches: no masks
+                    same_shape=self._same_shapes,
+                    fit_one=self._fit_ds,
+                    fit_fused=self._fit_fused_graph,
+                )
             if hasattr(iterator, "reset"):
                 iterator.reset()
         return self
+
+    @staticmethod
+    def _components(ds):
+        """(features_list, labels_list, has_masks) for either container."""
+        if hasattr(ds, "features_list"):  # MultiDataSet
+            masks = any(m is not None for m in (ds.features_masks or [])) \
+                or any(m is not None for m in (ds.labels_masks or []))
+            return list(ds.features_list), list(ds.labels_list), masks
+        return ([ds.features], [ds.labels],
+                ds.features_mask is not None or ds.labels_mask is not None)
+
+    def _graph_stackable(self, ds) -> bool:
+        return not self._components(ds)[2]  # fit_batches is mask-free
+
+    def _same_shapes(self, a, b) -> bool:
+        fa, la, _ = self._components(a)
+        fb, lb, _ = self._components(b)
+        return (
+            len(fa) == len(fb) and len(la) == len(lb)
+            and all(np.asarray(x).shape == np.asarray(y).shape
+                    for x, y in zip(fa + la, fb + lb))
+        )
+
+    def _fit_ds(self, ds) -> None:
+        if hasattr(ds, "features_list"):  # MultiDataSet
+            self.fit(ds.features_list, ds.labels_list, ds.features_masks,
+                     ds.labels_masks)
+        else:
+            self.fit(ds.features, ds.labels, ds.features_mask,
+                     ds.labels_mask)
+
+    def _fit_fused_graph(self, buf) -> None:
+        feats0, labs0, _ = self._components(buf[0])
+        comps = [self._components(d) for d in buf]
+        feats = [np.stack([np.asarray(c[0][i]) for c in comps])
+                 for i in range(len(feats0))]
+        labs = [np.stack([np.asarray(c[1][i]) for c in comps])
+                for i in range(len(labs0))]
+        self.fit_batches(feats, labs)
 
     # ------------------------------------------------------------- inference
     def _get_output_fn(self):

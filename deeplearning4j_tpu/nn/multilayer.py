@@ -550,21 +550,22 @@ class MultiLayerNetwork:
                  # line search) fall back to the per-step fit() they need
                  and self.conf.optimization_algo
                  == "stochastic_gradient_descent")
+        from deeplearning4j_tpu.nn.common import fused_iterator_loop
+
+        fit_one = lambda ds: self.fit(ds.features, ds.labels,
+                                      ds.features_mask, ds.labels_mask)
         for _ in range(num_epochs):
-            buf = []
-            for ds in iterator:
-                if not fused:
-                    self.fit(ds.features, ds.labels, ds.features_mask,
-                             ds.labels_mask)
-                    continue
-                if buf and not self._stackable(buf[0], ds):
-                    self._drain(buf)  # shape/mask change: flush per-step
-                    buf = []
-                buf.append(ds)
-                if len(buf) == fused_batches:
-                    self._fit_fused(buf)
-                    buf = []
-            self._drain(buf)  # ragged tail: per-step
+            if not fused:
+                for ds in iterator:
+                    fit_one(ds)
+            else:
+                fused_iterator_loop(
+                    iterator, fused_batches,
+                    can_stack=lambda ds: True,  # fit_batches stacks masks
+                    same_shape=self._stackable,
+                    fit_one=fit_one,
+                    fit_fused=self._fit_fused,
+                )
             if hasattr(iterator, "reset"):
                 iterator.reset()
         return self
@@ -577,11 +578,6 @@ class MultiLayerNetwork:
             and (a.features_mask is None) == (b.features_mask is None)
             and (a.labels_mask is None) == (b.labels_mask is None)
         )
-
-    def _drain(self, buf) -> None:
-        for ds in buf:
-            self.fit(ds.features, ds.labels, ds.features_mask,
-                     ds.labels_mask)
 
     def _fit_fused(self, buf) -> None:
         stack = lambda get: (

@@ -20,6 +20,13 @@ from dataclasses import dataclass
 from typing import Optional
 
 
+# THE env-var contract between launchers (provision/tpu_pod.py bootstrap)
+# and this runtime — both sides import these names, so they cannot drift
+COORDINATOR_ENV = "DL4J_TPU_COORDINATOR"
+NUM_PROCESSES_ENV = "DL4J_TPU_NUM_PROCESSES"
+PROCESS_ID_ENV = "DL4J_TPU_PROCESS_ID"
+
+
 @dataclass
 class MultiHostConfig:
     """The coordinator triple (jax.distributed.initialize signature);
@@ -33,9 +40,9 @@ class MultiHostConfig:
     @classmethod
     def from_env(cls) -> "MultiHostConfig":
         return cls(
-            coordinator_address=os.environ.get("DL4J_TPU_COORDINATOR"),
-            num_processes=_int_env("DL4J_TPU_NUM_PROCESSES"),
-            process_id=_int_env("DL4J_TPU_PROCESS_ID"),
+            coordinator_address=os.environ.get(COORDINATOR_ENV),
+            num_processes=_int_env(NUM_PROCESSES_ENV),
+            process_id=_int_env(PROCESS_ID_ENV),
         )
 
     def is_configured(self) -> bool:

@@ -127,7 +127,8 @@ class GcsDataSetLoader:
                     "CSV shards need num_classes= on the loader — a "
                     "per-shard labels.max() would give different shards "
                     "different one-hot widths")
-            raw = np.loadtxt(path, delimiter=",", dtype=np.float32)
+            # ndmin=2: a single-row shard must keep the 2-D contract
+            raw = np.loadtxt(path, delimiter=",", dtype=np.float32, ndmin=2)
             labels = raw[:, -1].astype(np.int64)
             return (raw[:, :-1],
                     np.eye(num_classes, dtype=np.float32)[labels])

@@ -24,7 +24,11 @@ import subprocess
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-_HOSTS_PER_TYPE_DEFAULT = 8  # chips per host on current TPU generations
+from deeplearning4j_tpu.parallel.multihost import (
+    COORDINATOR_ENV,
+    NUM_PROCESSES_ENV,
+    PROCESS_ID_ENV,
+)
 
 
 @dataclass(frozen=True)
@@ -38,7 +42,6 @@ class TpuPodSpec:
     runtime_version: str = "tpu-ubuntu2204-base"  # -ami role
     project: Optional[str] = None
     coordinator_port: int = 8476
-    chips_per_host: int = _HOSTS_PER_TYPE_DEFAULT
 
     @property
     def num_chips(self) -> int:
@@ -52,7 +55,14 @@ class TpuPodSpec:
 
     @property
     def num_hosts(self) -> int:
-        return max(1, self.num_chips // self.chips_per_host)
+        """Planning ESTIMATE only (v5e/v5p/v6e VMs carry 4 chips; v4 types
+        count TensorCores, 8 per 4-chip host). The bootstrap derives the
+        AUTHORITATIVE process count on-host from TPU_WORKER_HOSTNAMES, so
+        a topology this table mispredicts still launches correctly."""
+        n = self.num_chips
+        if self.accelerator_type.startswith("v4"):
+            return max(1, n // 8)
+        return max(1, n // 4)
 
     def _gcloud(self, *args: str) -> List[str]:
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
@@ -63,14 +73,16 @@ class TpuPodSpec:
 
 
 def host_env(spec: TpuPodSpec, process_id: int,
-             coordinator_host: str = "$(hostname -i)") -> Dict[str, str]:
+             coordinator_host: str = "$(hostname -i)",
+             num_processes: Optional[int] = None) -> Dict[str, str]:
     """The per-host jax.distributed env (MultiHostConfig.from_env contract;
     the reference's ZooKeeperConfigurationRegister role): worker 0 is the
-    coordinator, every host learns the triple through env vars."""
+    coordinator, every host learns the triple through env vars. Env NAMES
+    come from parallel/multihost.py so launcher and runtime cannot drift."""
     return {
-        "DL4J_TPU_COORDINATOR": f"{coordinator_host}:{spec.coordinator_port}",
-        "DL4J_TPU_NUM_PROCESSES": str(spec.num_hosts),
-        "DL4J_TPU_PROCESS_ID": str(process_id),
+        COORDINATOR_ENV: f"{coordinator_host}:{spec.coordinator_port}",
+        NUM_PROCESSES_ENV: str(num_processes or spec.num_hosts),
+        PROCESS_ID_ENV: str(process_id),
     }
 
 
@@ -82,6 +94,16 @@ def bootstrap_script(spec: TpuPodSpec, repo_dir: str, train_cmd: str) -> str:
     metadata environment (TPU_WORKER_HOSTNAMES lists every host, worker 0
     first; TPU_WORKER_ID is this host's index) — no describe-output
     parsing, and a single-host slice falls back to its own address."""
+    # the three exports are GENERATED from host_env() so the script and
+    # the tested MultiHostConfig contract share one source of truth; the
+    # values are shell expressions resolved on-host (true process count
+    # from the hostname list — never a python-side per-generation guess)
+    env = host_env(spec, process_id=0, coordinator_host="${COORDINATOR_IP}")
+    exports = {
+        COORDINATOR_ENV: env[COORDINATOR_ENV],
+        NUM_PROCESSES_ENV: '"${NUM_PROC}"',
+        PROCESS_ID_ENV: '"${PROC_ID}"',
+    }
     lines = [
         "#!/bin/bash",
         "set -euo pipefail",
@@ -90,10 +112,10 @@ def bootstrap_script(spec: TpuPodSpec, repo_dir: str, train_cmd: str) -> str:
         # worker 0's hostname from the TPU metadata env; self for 1-host
         'COORDINATOR_IP="$(echo "${TPU_WORKER_HOSTNAMES:-$(hostname -i)}" '
         '| cut -d, -f1)"',
-        f'export DL4J_TPU_COORDINATOR='
-        f'"${{COORDINATOR_IP}}:{spec.coordinator_port}"',
-        f'export DL4J_TPU_NUM_PROCESSES={spec.num_hosts}',
-        'export DL4J_TPU_PROCESS_ID="${PROC_ID}"',
+        # AUTHORITATIVE host count = length of the hostname list
+        'NUM_PROC="$(echo "${TPU_WORKER_HOSTNAMES:-localhost}" '
+        "| awk -F, '{print NF}')\"",
+    ] + [f'export {k}={v}' for k, v in exports.items()] + [
         f"export PYTHONPATH={shlex.quote(repo_dir)}:${{PYTHONPATH:-}}",
         # initialize_multihost() picks the triple up from the env
         train_cmd,

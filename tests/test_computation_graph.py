@@ -26,6 +26,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     OutputLayer,
     RnnOutputLayer,
 )
+from deeplearning4j_tpu.datasets.iterator import DataSet
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 
@@ -696,7 +697,6 @@ def test_graph_performance_dtype_policy_trains():
     import numpy as np
 
     from deeplearning4j_tpu.datasets.fetchers import load_iris
-    from deeplearning4j_tpu.nn.graph import ComputationGraph
 
     x, y = load_iris()
     conf = (
@@ -722,3 +722,45 @@ def test_graph_performance_dtype_policy_trains():
     for lp in net.params.values():
         for a in lp.values():
             assert a.dtype == jnp.float32
+
+
+class TestFusedFitIterator:
+    def test_fused_equals_per_step(self):
+        """fit_iterator(fused_batches=K) on a graph == the per-step loop
+        exactly (fit_batches serial equivalence), incl. the ragged tail."""
+        x, y = _iris_like(n=80, seed=3)
+        ds_list = [DataSet(x[i:i + 16], y[i:i + 16])
+                   for i in range(0, 80, 16)]
+        a = ComputationGraph(_simple_graph_conf(seed=31)).init()
+        b = ComputationGraph(_simple_graph_conf(seed=31)).init()
+        a.fit_iterator(list(ds_list), num_epochs=2)
+        b.fit_iterator(list(ds_list), num_epochs=2, fused_batches=2)
+        for name in a.params:
+            for k in a.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[name][k]),
+                    np.asarray(b.params[name][k]), rtol=1e-6, atol=1e-7)
+        assert a.iteration == b.iteration
+
+    def test_masked_datasets_fall_back(self):
+        """Masked DataSets can't stack through the mask-free fit_batches —
+        they run per-step (and still train)."""
+        rng = np.random.default_rng(0)
+        conf = (
+            NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+            .graph_builder().add_inputs("in")
+            .add_layer("l", GravesLSTM(n_in=3, n_out=8), "in")
+            .add_layer("out", RnnOutputLayer(n_in=8, n_out=2,
+                                             loss_function="mcxent",
+                                             activation="softmax"), "l")
+            .set_outputs("out").build()
+        )
+        net = ComputationGraph(conf).init(input_shapes={"in": (-1, 3)})
+        x = rng.normal(size=(4, 6, 3)).astype(np.float32)
+        yy = np.zeros((4, 6, 2), np.float32)
+        yy[..., 0] = 1.0
+        m = np.ones((4, 6), np.float32)
+        m[:, 4:] = 0.0
+        ds = [DataSet(x, yy, m, m) for _ in range(4)]
+        net.fit_iterator(ds, fused_batches=2)
+        assert net.iteration == 4

@@ -104,3 +104,30 @@ class TestLstmWinTable:
         assert not lstm_kernel_wins(32, 128, 128)
         assert lstm_kernel_wins(64, 256, 256)
         assert lstm_kernel_wins(128, 512, 512)
+
+
+def test_bench_ring_attention_leg_executes():
+    """The on-chip ring bench leg has ONE shot when the tunnel returns —
+    smoke it here (interpret kernel, tiny shapes, CPU) so a code bug can't
+    burn it. The recorded row is redirected to a temp artifact."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        import deeplearning4j_tpu.ops.kernel_gate as kg
+
+        old = kg._ARTIFACT
+        kg._ARTIFACT = f"{d}/PALLAS_BENCH.json"
+        kg.reload()
+        try:
+            out = bench.bench_ring_attention(n=1, t=256, h=2, d=32, steps=1,
+                                             interpret=True)
+        finally:
+            kg._ARTIFACT = old
+            kg.reload()
+    assert "ring_einsum_ms" in out and "ring_flash_ms" in out
+    assert out["flash_speedup"] > 0

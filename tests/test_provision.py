@@ -30,7 +30,7 @@ def _spec(**kw):
 class TestSpec:
     def test_chip_and_host_counts(self):
         assert _spec().num_chips == 16
-        assert _spec().num_hosts == 2
+        assert _spec().num_hosts == 4   # v5e: 4 chips per host VM
         assert _spec(accelerator_type="v4-8").num_hosts == 1
 
     def test_bad_accelerator_type_raises(self):
@@ -72,13 +72,14 @@ class TestClusterPlan:
         monkeypatch.setenv("DL4J_TPU_PROCESS_ID", "1")
         cfg = MultiHostConfig.from_env()
         assert cfg.coordinator_address == "10.0.0.2:8476"
-        assert cfg.num_processes == 2
+        assert cfg.num_processes == 4
         assert cfg.process_id == 1
         assert cfg.is_configured()
 
     def test_bootstrap_script_contents(self):
         script = bootstrap_script(_spec(), "/opt/repo", "python train.py")
-        assert "DL4J_TPU_NUM_PROCESSES=2" in script
+        # the process count is resolved ON-HOST, never baked in python
+        assert 'DL4J_TPU_NUM_PROCESSES="${NUM_PROC}"' in script
         assert 'DL4J_TPU_PROCESS_ID="${PROC_ID}"' in script
         assert "PYTHONPATH=/opt/repo" in script
         assert script.rstrip().endswith("python train.py")
@@ -148,6 +149,7 @@ class TestReviewRegressions:
             assert out.returncode == 0, out.stderr
             assert "DL4J_TPU_COORDINATOR=10.0.0.5:8476" in out.stdout
             assert "DL4J_TPU_PROCESS_ID=1" in out.stdout
+            assert "DL4J_TPU_NUM_PROCESSES=2" in out.stdout  # from hostnames
 
     def test_cache_key_uses_full_object_path(self, tmp_path):
         from deeplearning4j_tpu.provision.gcs import GcsDownloader
