@@ -397,7 +397,25 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
         mfu = (flops / (dt / steps)) / _peak_flops_per_chip()
     from deeplearning4j_tpu.ops.pallas_attention import flash_fits, pallas_enabled
 
+    # generation throughput: KV-cache decode (O(T) per token) vs the
+    # full-forward sampler (O(T^2) per token) — the rnnTimeStep-style
+    # streaming win for the flagship
+    gen = {}
+    prompt = x[:, :128]
+    for uc, label in ((True, "kv"), (False, "full")):
+        out = lm.generate(prompt, n_new=64, temperature=1.0, seed=0,
+                          use_cache=uc)  # compile + warm
+        _force(out)
+        t0 = time.perf_counter()
+        out = lm.generate(prompt, n_new=64, temperature=1.0, seed=1,
+                          use_cache=uc)
+        _force(out)
+        gen[label] = batch * 64 / (time.perf_counter() - t0)
+
     return {
+        "gen_tokens_per_sec_kv": round(gen["kv"], 1),
+        "gen_tokens_per_sec_full": round(gen["full"], 1),
+        "kv_cache_speedup": round(gen["kv"] / gen["full"], 2),
         "tokens_per_sec": round(tokens_per_sec, 1),
         "tokens_per_sec_fused": round(fused_tokens_per_sec, 1),
         # the TPU-first story quantified: K steps per XLA program vs one

@@ -554,6 +554,91 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
 
 
 # ---------------------------------------------------------------------------
+# KV-cache decoding (autoregressive inference without the O(T^2)-per-token
+# full-forward recompute; the reference's rnnTimeStep streaming idea —
+# MultiLayerNetwork.rnnTimeStep :2152 carries h/c state — applied to
+# attention: the carried state is each layer's K/V history)
+# ---------------------------------------------------------------------------
+
+
+def prefill_cache(params: Params, tokens: jax.Array, cfg: TransformerConfig,
+                  ) -> Tuple[Params, jax.Array]:
+    """Run the prompt through the model once, returning the per-layer K/V
+    cache (leaves [L, N, max_len, H, hd]; positions beyond the prompt are
+    garbage that decode's position mask never reads) plus the final hidden
+    states [N, T, d] (f32, post-final-LN). Mirrors forward()'s dense block
+    scan (same cast discipline); dense FFN only."""
+    if cfg.moe_experts:
+        raise NotImplementedError("KV-cache decoding supports dense FFN")
+    cdt = cfg.compute_dtype
+    n, t = tokens.shape
+    hd = cfg.d_model // cfg.n_heads
+    h = (params["embed"][tokens] + params["pos"][:t][None]).astype(cdt)
+
+    def block(h, bp):
+        # the SHARED block body (_dense_block_f32); the attend override
+        # both computes attention and CAPTURES this layer's K/V for the
+        # cache (capture works because scan traces the body once and the
+        # captured values are tracers feeding the scan outputs)
+        captured = {}
+
+        def attend(q, k, v):
+            captured["k"], captured["v"] = k, v
+            return _attention(q, k, v, cfg.n_heads, use_flash=cfg.use_flash)
+
+        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, cdt=cdt)
+        pad = ((0, 0), (0, cfg.max_len - t), (0, 0), (0, 0))
+        kc = jnp.pad(captured["k"].reshape(n, t, cfg.n_heads, hd), pad)
+        vc = jnp.pad(captured["v"].reshape(n, t, cfg.n_heads, hd), pad)
+        return h, (kc, vc)
+
+    h, (ks, vs) = lax.scan(block, h, params["blocks"])
+    h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return {"k": ks, "v": vs}, h
+
+
+def decode_step(params: Params, cache: Params, tok: jax.Array, pos,
+                cfg: TransformerConfig) -> Tuple[Params, jax.Array]:
+    """One autoregressive step: consume the token at position `pos`
+    (writing its K/V into the cache) and return (updated cache, logits for
+    position pos+1). tok: [N] int32; pos: traced scalar. Attention reads
+    the full max_len cache under an `arange <= pos` mask — O(max_len) per
+    token instead of the full forward's O(max_len^2)."""
+    cdt = cfg.compute_dtype
+    n = tok.shape[0]
+    hd = cfg.d_model // cfg.n_heads
+    h = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
+    scale = 1.0 / float(np.sqrt(hd))
+    visible = (jnp.arange(cfg.max_len) <= pos)[None, None, :]  # [1,1,T]
+
+    def block(h, xs):
+        bp, ck, cv = xs  # ck/cv: [N, T_max, H, hd]
+        c = lambda a: a.astype(cdt)
+        x = _ln(h, c(bp["ln1_g"]), c(bp["ln1_b"]))
+        q = (x @ c(bp["Wq"])).reshape(n, cfg.n_heads, hd)
+        k1 = (x @ c(bp["Wk"])).reshape(n, 1, cfg.n_heads, hd)
+        v1 = (x @ c(bp["Wv"])).reshape(n, 1, cfg.n_heads, hd)
+        ck = lax.dynamic_update_slice_in_dim(ck, k1.astype(ck.dtype), pos, 1)
+        cv = lax.dynamic_update_slice_in_dim(cv, v1.astype(cv.dtype), pos, 1)
+        s = jnp.einsum("nhd,nthd->nht", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) * scale
+        s = jnp.where(visible, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        att = jnp.einsum("nht,nthd->nhd", p,
+                         cv.astype(jnp.float32)).reshape(n, 1, cfg.d_model)
+        h = h + att.astype(cdt) @ c(bp["Wo"])
+        x = _ln(h, c(bp["ln2_g"]), c(bp["ln2_b"]))
+        h = h + jax.nn.gelu(x @ c(bp["W1"]) + c(bp["b1"])) @ c(bp["W2"]) \
+            + c(bp["b2"])
+        return h, (ck, cv)
+
+    h, (ks, vs) = lax.scan(block, h, (params["blocks"], cache["k"],
+                                      cache["v"]))
+    h = _ln(h[:, 0].astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return {"k": ks, "v": vs}, h @ params["embed"].T
+
+
+# ---------------------------------------------------------------------------
 # Sequence-parallel TRAINING (ring/Ulysses attention + loss + Adam in one
 # jitted step over a ('seq',) or ('data', 'seq') mesh)
 # ---------------------------------------------------------------------------
@@ -1019,18 +1104,59 @@ class TransformerLM:
         self._gen_cache[n_new] = sample
         return sample
 
+    def _sample_kv_fn(self, n_new: int):
+        """KV-cache sampler (prefill once, then one decode_step per token
+        — O(max_len) each instead of a full O(max_len^2) forward). Cached
+        per n_new; the prefill width max_len - n_new is static, so prompt
+        length never forces a recompile (window right-padded; pad K/V
+        entries are either overwritten before first read or masked)."""
+        key_c = ("kv", n_new)
+        cached = self._gen_cache.get(key_c)
+        if cached is not None:
+            return cached
+        cfg = self._run_cfg
+
+        @jax.jit
+        def sample(params, buf, pos0, key, temperature):
+            cache, _ = prefill_cache(params, buf, cfg)
+            n = buf.shape[0]
+            tok = jnp.take_along_axis(
+                buf, (pos0 - 1)[None, None].repeat(n, 0), axis=1)[:, 0]
+
+            def one(carry, i):
+                cache, tok, key = carry
+                cache, logits = decode_step(params, cache, tok,
+                                            pos0 - 1 + i, cfg)
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, logits / jnp.maximum(temperature, 1e-6))
+                return (cache, nxt.astype(buf.dtype), key), nxt
+
+            _, out = lax.scan(one, (cache, tok, key), jnp.arange(n_new))
+            return out.T  # [N, n_new]
+
+        self._gen_cache[key_c] = sample
+        return sample
+
     def generate(self, prompt: jax.Array, n_new: int, temperature: float = 1.0,
-                 seed: int = 0) -> jax.Array:
+                 seed: int = 0, use_cache: Optional[bool] = None) -> jax.Array:
         """Sample n_new tokens after the prompt (static shapes throughout:
         one compile per n_new). prompt len + n_new must fit max_len; longer
-        prompts keep their last (max_len - n_new) tokens."""
+        prompts keep their last (max_len - n_new) tokens. use_cache:
+        KV-cache decoding (default on for dense single-device models —
+        O(max_len) per token); the full-forward sampler remains for MoE
+        and mesh-sharded models (and as the equivalence oracle)."""
         cfg = self._run_cfg
         if n_new >= cfg.max_len:
             raise ValueError(f"n_new {n_new} must be < max_len {cfg.max_len}")
+        if use_cache is None:
+            use_cache = self.mesh is None and not cfg.moe_experts
         t = prompt.shape[1]
         keep = min(t, cfg.max_len - n_new)
         window = prompt[:, t - keep:]
-        buf = jnp.pad(window, ((0, 0), (0, cfg.max_len - keep)))
-        return self._sample_fn(n_new)(
+        width = (cfg.max_len - n_new) if use_cache else cfg.max_len
+        buf = jnp.pad(window, ((0, 0), (0, width - keep)))
+        fn = self._sample_kv_fn(n_new) if use_cache else self._sample_fn(n_new)
+        return fn(
             self.params, buf, jnp.asarray(keep, jnp.int32),
             jax.random.PRNGKey(seed), jnp.asarray(temperature, jnp.float32))
