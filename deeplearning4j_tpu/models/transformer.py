@@ -920,7 +920,7 @@ class TransformerLM:
             self.params = shard_params_for_mesh(self.params, cfg, mesh)
         self.opt = init_opt_state(self.params)
         self._step = self._make_step()
-        self._gen_cache: Dict[int, Any] = {}
+        self._gen_cache: Dict[tuple, Any] = {}
         self.iteration = 0
 
     def _pipeline_mode(self) -> bool:
@@ -1070,20 +1070,21 @@ class TransformerLM:
             lm.params = shard_params_for_mesh(lm.params, cfg, mesh)
         return lm
 
-    def _sample_fn(self, n_new: int):
+    def _sample_fn(self, n_new: int, top_k=None, has_top_p=False):
         """Jitted sampler, cached per n_new (a fresh @jax.jit closure per
         generate() call would recompile every time); temperature and key are
         traced args so they never force recompiles. The token buffer keeps
         the prompt at positions 0..t-1 (RIGHT-padded with zeros that causal
         masking makes invisible), so position embeddings match training —
         left-padding would condition sampling on a fake zero-token prefix."""
-        cached = self._gen_cache.get(n_new)
+        cached = self._gen_cache.get((n_new, top_k, has_top_p))
         if cached is not None:
             return cached
         cfg = self._run_cfg
+        filt = self._filter_logits
 
         @jax.jit
-        def sample(params, buf, pos0, key, temperature):
+        def sample(params, buf, pos0, key, temperature, top_p):
             def one(carry, i):
                 buf, key = carry
                 logits, _ = forward(params, buf, cfg)
@@ -1092,8 +1093,10 @@ class TransformerLM:
                     logits, (pos - 1)[None, None, None].repeat(
                         buf.shape[0], 0), axis=1)[:, 0]
                 key, sub = jax.random.split(key)
+                tempered = last / jnp.maximum(temperature, 1e-6)
                 nxt = jax.random.categorical(
-                    sub, last / jnp.maximum(temperature, 1e-6))
+                    sub, filt(tempered, top_k,
+                              top_p if has_top_p else None))
                 buf = lax.dynamic_update_slice_in_dim(
                     buf, nxt[:, None].astype(buf.dtype), pos, axis=1)
                 return (buf, key), nxt
@@ -1101,23 +1104,48 @@ class TransformerLM:
             (_, _), out = lax.scan(one, (buf, key), jnp.arange(n_new))
             return out.T  # [N, n_new]
 
-        self._gen_cache[n_new] = sample
+        self._gen_cache[(n_new, top_k, has_top_p)] = sample
         return sample
 
-    def _sample_kv_fn(self, n_new: int):
+    @staticmethod
+    def _filter_logits(logits, top_k: Optional[int], top_p):
+        """Top-k / nucleus (top-p) filtering of TEMPERED logits (callers
+        scale by temperature first — the standard order, so the nucleus is
+        computed on the distribution actually sampled). top_k is static
+        (lax.top_k needs a static k; one compile per k); top_p is a TRACED
+        scalar (or None to skip) — sweeping it never recompiles. Filters
+        compose: k first, then the smallest set of remaining tokens whose
+        cumulative probability reaches top_p (the top token always
+        survives: its preceding cumulative mass is 0)."""
+        if top_k is not None:
+            kth = lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p is not None:
+            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = (cum - probs) < top_p  # cumprob BEFORE the token
+            thresh = jnp.min(
+                jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1,
+                keepdims=True)
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
+        return logits
+
+    def _sample_kv_fn(self, n_new: int, top_k=None, has_top_p=False):
         """KV-cache sampler (prefill once, then one decode_step per token
         — O(max_len) each instead of a full O(max_len^2) forward). Cached
         per n_new; the prefill width max_len - n_new is static, so prompt
         length never forces a recompile (window right-padded; pad K/V
         entries are either overwritten before first read or masked)."""
-        key_c = ("kv", n_new)
+        key_c = ("kv", n_new, top_k, has_top_p)
         cached = self._gen_cache.get(key_c)
         if cached is not None:
             return cached
         cfg = self._run_cfg
+        filt = self._filter_logits
 
         @jax.jit
-        def sample(params, buf, pos0, key, temperature):
+        def sample(params, buf, pos0, key, temperature, top_p):
             cache, _ = prefill_cache(params, buf, cfg)
             n = buf.shape[0]
             tok = jnp.take_along_axis(
@@ -1128,8 +1156,10 @@ class TransformerLM:
                 cache, logits = decode_step(params, cache, tok,
                                             pos0 - 1 + i, cfg)
                 key, sub = jax.random.split(key)
+                tempered = logits / jnp.maximum(temperature, 1e-6)
                 nxt = jax.random.categorical(
-                    sub, logits / jnp.maximum(temperature, 1e-6))
+                    sub, filt(tempered, top_k,
+                              top_p if has_top_p else None))
                 return (cache, nxt.astype(buf.dtype), key), nxt
 
             _, out = lax.scan(one, (cache, tok, key), jnp.arange(n_new))
@@ -1139,7 +1169,9 @@ class TransformerLM:
         return sample
 
     def generate(self, prompt: jax.Array, n_new: int, temperature: float = 1.0,
-                 seed: int = 0, use_cache: Optional[bool] = None) -> jax.Array:
+                 seed: int = 0, use_cache: Optional[bool] = None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None) -> jax.Array:
         """Sample n_new tokens after the prompt (static shapes throughout:
         one compile per n_new). prompt len + n_new must fit max_len; longer
         prompts keep their last (max_len - n_new) tokens. use_cache:
@@ -1149,6 +1181,10 @@ class TransformerLM:
         cfg = self._run_cfg
         if n_new >= cfg.max_len:
             raise ValueError(f"n_new {n_new} must be < max_len {cfg.max_len}")
+        if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+            raise ValueError(f"top_k {top_k} must be in [1, vocab_size]")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p {top_p} must be in (0, 1]")
         if use_cache is None:
             use_cache = self.mesh is None and not cfg.moe_experts
         t = prompt.shape[1]
@@ -1156,7 +1192,10 @@ class TransformerLM:
         window = prompt[:, t - keep:]
         width = (cfg.max_len - n_new) if use_cache else cfg.max_len
         buf = jnp.pad(window, ((0, 0), (0, width - keep)))
-        fn = self._sample_kv_fn(n_new) if use_cache else self._sample_fn(n_new)
+        has_tp = top_p is not None
+        fn = (self._sample_kv_fn(n_new, top_k, has_tp) if use_cache
+              else self._sample_fn(n_new, top_k, has_tp))
         return fn(
             self.params, buf, jnp.asarray(keep, jnp.int32),
-            jax.random.PRNGKey(seed), jnp.asarray(temperature, jnp.float32))
+            jax.random.PRNGKey(seed), jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p if has_tp else 1.0, jnp.float32))

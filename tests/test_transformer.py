@@ -418,3 +418,73 @@ class TestKVCacheDecoding:
         np.testing.assert_allclose(np.asarray(logits),
                                    np.asarray(full_logits[:, 3]),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestSamplingFilters:
+    def test_top_k_1_equals_greedy(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+        greedy = lm.generate(prompt, n_new=6, temperature=1e-8, seed=0)
+        topk1 = lm.generate(prompt, n_new=6, temperature=1.0, seed=0,
+                            top_k=1)
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+    def test_top_k_restricts_support(self):
+        """Every sampled token must be inside the per-step top-k set; with
+        k=2 and many samples the argmax or runner-up appears."""
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+        first_logits = lm.logits(prompt)[0, -1]
+        top2 = set(np.argsort(np.asarray(first_logits))[-2:].tolist())
+        for seed in range(5):
+            out = lm.generate(prompt, n_new=1, temperature=1.0, seed=seed,
+                              top_k=2)
+            assert int(out[0, 0]) in top2
+
+    def test_top_p_keeps_at_least_argmax(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        out = lm.generate(prompt, n_new=1, temperature=1.0, seed=0,
+                          top_p=1e-9)  # nucleus collapses to the argmax
+        expect = int(jnp.argmax(lm.logits(prompt)[0, -1]))
+        assert int(out[0, 0]) == expect
+
+    def test_filters_on_full_forward_sampler_too(self):
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[5, 9, 2, 7]], jnp.int32)
+        a = lm.generate(prompt, n_new=4, temperature=1.0, seed=2, top_k=1,
+                        use_cache=False)
+        b = lm.generate(prompt, n_new=4, temperature=1e-8, seed=2,
+                        use_cache=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestSamplingValidation:
+    def test_bad_filter_args_raise(self):
+        import pytest
+
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        p = jnp.asarray([[1, 2]], jnp.int32)
+        with pytest.raises(ValueError):
+            lm.generate(p, n_new=2, top_k=0)
+        with pytest.raises(ValueError):
+            lm.generate(p, n_new=2, top_k=cfg.vocab_size + 1)
+        with pytest.raises(ValueError):
+            lm.generate(p, n_new=2, top_p=0.0)
+        with pytest.raises(ValueError):
+            lm.generate(p, n_new=2, top_p=1.5)
+
+    def test_top_p_sweep_reuses_one_compile(self):
+        """top_p is a traced scalar: sweeping it must hit ONE cached
+        sampler, not compile per value."""
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        p = jnp.asarray([[1, 2, 3]], jnp.int32)
+        for tp in (0.8, 0.9, 0.95):
+            lm.generate(p, n_new=2, top_p=tp, seed=0)
+        assert len(lm._gen_cache) == 1
