@@ -49,7 +49,9 @@ def main():
             print(f"step {step}: loss {loss:.3f}")
 
     prompt = jnp.asarray([[stoi[c] for c in "to be "]], jnp.int32)
-    out = lm.generate(prompt, n_new=40, temperature=0.8, seed=0)
+    # KV-cache decoding (default), nucleus sampling: O(max_len) per token
+    out = lm.generate(prompt, n_new=40, temperature=0.8, seed=0,
+                      top_k=min(50, cfg.vocab_size), top_p=0.95)
     print("sample:", "to be " + "".join(chars[int(i)] for i in out[0]))
 
 
