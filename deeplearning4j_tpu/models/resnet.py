@@ -128,8 +128,10 @@ def resnet50_conf(
     return gb.set_outputs("out").build()
 
 
-def build_resnet50(input_size: int = 224, num_classes: int = 1000, **kw) -> ComputationGraph:
-    conf = resnet50_conf(num_classes=num_classes, input_size=input_size, **kw)
+def build_resnet50(input_size: int = 224, num_classes: int = 1000,
+                   in_channels: int = 3, **kw) -> ComputationGraph:
+    conf = resnet50_conf(num_classes=num_classes, input_size=input_size,
+                         in_channels=in_channels, **kw)
     net = ComputationGraph(conf)
-    net.init(input_shapes={"in": (input_size, input_size, 3)})
+    net.init(input_shapes={"in": (input_size, input_size, in_channels)})
     return net

@@ -154,3 +154,42 @@ class TestDbn:
         assert conf.pretrain is True
         rt = MultiLayerConfiguration.from_json(conf.to_json())
         assert rt.to_json() == conf.to_json()
+
+
+class TestGoogLeNet:
+    def test_param_count_matches_canonical(self):
+        """Inception-v1 at 224px without aux heads: canonical ~6.99M
+        params (Szegedy et al. 2014 Table 1)."""
+        from deeplearning4j_tpu.models.googlenet import googlenet_conf
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        net = ComputationGraph(googlenet_conf())
+        net.init(input_shapes={"in": (224, 224, 3)})
+        n = net.num_params()
+        assert 6.5e6 < n < 7.5e6, f"{n/1e6:.2f}M"
+
+    def test_trains_and_merges_towers(self):
+        from deeplearning4j_tpu.models.googlenet import build_googlenet
+
+        rng = np.random.default_rng(0)
+        net = build_googlenet(input_size=64, num_classes=10)
+        x = rng.random((4, 64, 64, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+        l1 = float(net.fit(x, y))
+        l2 = float(net.fit(x, y))
+        assert np.isfinite(l1) and l2 < l1
+
+    def test_aux_heads_three_output_training(self):
+        """The paper's auxiliary classifiers as extra graph OUTPUTS — the
+        reference's multi-output fit path (one label array per output)."""
+        from deeplearning4j_tpu.models.googlenet import build_googlenet
+
+        rng = np.random.default_rng(1)
+        net = build_googlenet(input_size=64, num_classes=10, aux_heads=True)
+        assert len(net.conf.outputs) == 3
+        x = rng.random((4, 64, 64, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+        loss = float(net.fit(x, [y, y, y]))
+        assert np.isfinite(loss)
+        outs = net.output(x)
+        assert len(outs) == 3 and outs[0].shape == (4, 10)
