@@ -81,6 +81,14 @@ class TransformerConfig:
     # GPipe microbatch count used when TransformerLM is built on a mesh
     # with a 'pipe' axis (pipeline mode); must divide the fit() batch size
     pipeline_microbatches: int = 4
+    # decoupled weight decay (AdamW, Loshchilov & Hutter): applied to
+    # matrix params only (LN scales/biases and the position table exempt,
+    # the standard LM recipe); 0 = plain Adam
+    weight_decay: float = 0.0
+    # global-norm gradient clipping before the optimizer update; 0 = off
+    # (the reference's GradientNormalization ClipL2PerParamType role —
+    # nn/conf/GradientNormalization.java — for the flagship)
+    clip_grad_norm: float = 0.0
 
     @property
     def compute_dtype(self):
@@ -343,7 +351,39 @@ def init_opt_state(params: Params) -> Params:
     }
 
 
-def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
+def _clip_by_global_norm(grads, max_norm):
+    """Global-norm clip (the standard LM recipe): ONE implementation — the
+    framework's shared gradient-normalization path
+    (optimize/updaters.normalize_gradients, reference
+    GradientNormalization ClipL2 role) applied to the WHOLE param tree."""
+    from deeplearning4j_tpu.optimize.updaters import (
+        _global_norm,
+        normalize_gradients,
+    )
+
+    return (normalize_gradients(grads, "clip_l2_per_layer", max_norm),
+            _global_norm(grads))
+
+
+def _decay_mask(params):
+    """AdamW applies decay to weight MATRICES only (keys 'W*' and the tied
+    embedding); LN scales/biases, biases and the position table are
+    exempt. The decision is BY NAME — block leaves carry a leading [L]
+    layer dim, so ndim alone cannot tell a stacked bias (L, f) from a
+    matrix."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, _ in flat:
+        last = path[-1]
+        name = str(getattr(last, "key", last))
+        out.append(name.startswith("W") or name == "embed")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8,
+                 weight_decay=0.0, clip_grad_norm=0.0):
+    if clip_grad_norm:
+        grads, _ = _clip_by_global_norm(grads, clip_grad_norm)
     t = opt["t"] + 1
     m = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
                                opt["m"], grads)
@@ -351,9 +391,16 @@ def _adam_update(params, grads, opt, lr, b1=0.9, b2=0.999, eps=1e-8):
                                opt["v"], grads)
     tf = t.astype(jnp.float32)
     corr = jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
-    new = jax.tree_util.tree_map(
-        lambda p, m, v: p - lr * corr * m / (jnp.sqrt(v) + eps),
-        params, m, v)
+    if weight_decay:
+        mask = _decay_mask(params)
+        new = jax.tree_util.tree_map(
+            lambda p, m, v, d: p - lr * (corr * m / (jnp.sqrt(v) + eps)
+                                         + (weight_decay * p if d else 0.0)),
+            params, m, v, mask)
+    else:
+        new = jax.tree_util.tree_map(
+            lambda p, m, v: p - lr * corr * m / (jnp.sqrt(v) + eps),
+            params, m, v)
     return new, {"m": m, "v": v, "t": t}
 
 
@@ -438,7 +485,9 @@ def _build_step(cfg: TransformerConfig):
             (loss, grads), _ = lax.scan(
                 micro, (jnp.zeros((), jnp.float32), zero), (xs, ys))
         lr = _scheduled_lr(cfg, opt["t"] + 1)
-        params, opt = _adam_update(params, grads, opt, lr)
+        params, opt = _adam_update(params, grads, opt, lr,
+                                   weight_decay=cfg.weight_decay,
+                                   clip_grad_norm=cfg.clip_grad_norm)
         return params, opt, loss
 
     return step
@@ -684,7 +733,9 @@ def _build_ring_step(cfg, mesh, strategy):
     def step(params, opt, tokens, targets):
         loss, grads = jax.value_and_grad(sp_loss)(params, tokens, targets)
         lr = _scheduled_lr(cfg, opt["t"] + 1)
-        params, opt = _adam_update(params, grads, opt, lr)
+        params, opt = _adam_update(params, grads, opt, lr,
+                                   weight_decay=cfg.weight_decay,
+                                   clip_grad_norm=cfg.clip_grad_norm)
         return params, opt, loss
 
     return step
@@ -846,7 +897,9 @@ def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
     def step(params, opt, tokens, targets):
         loss, grads = jax.value_and_grad(pp_loss)(params, tokens, targets)
         lr = _scheduled_lr(cfg, opt["t"] + 1)
-        params, opt = _adam_update(params, grads, opt, lr)
+        params, opt = _adam_update(params, grads, opt, lr,
+                                   weight_decay=cfg.weight_decay,
+                                   clip_grad_norm=cfg.clip_grad_norm)
         return params, opt, loss
 
     return step
