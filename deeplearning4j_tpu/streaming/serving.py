@@ -7,6 +7,8 @@ and runs output() on each incoming record): a stdlib HTTP server exposing
   POST /predict   {"record": [..floats..]}            -> {"output": [...]}
                   {"record_base64": "<b64 floats>"}   -> {"output": [...]}
                   {"batch": [[...], ...]}             -> {"outputs": [[...], ...]}
+  POST /generate  {"tokens": [[ids]], "n_new": K, ...} -> {"tokens": [[ids]]}
+                  (flagship LM sampling through the KV-cache decoder)
   GET  /health    {"ok": true, "model": "<type>"}
 
 The model is restored once at startup (ModelSerializer.restore — the same
@@ -61,13 +63,19 @@ class ModelServer:
                 else:
                     self._send(404, {"error": "not found"})
 
+            def _read_json(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n))
+
             def do_POST(self):
+                if self.path == "/generate":
+                    self._do_generate()
+                    return
                 if self.path != "/predict":
                     self._send(404, {"error": "not found"})
                     return
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n))
+                    payload = self._read_json()
                     if "record_base64" in payload:
                         x = decode_record_base64(payload["record_base64"])[None]
                     elif "record" in payload:
@@ -84,6 +92,35 @@ class ModelServer:
                 except Exception as e:  # noqa: BLE001 — serving boundary
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
 
+            def _do_generate(self):
+                """POST /generate {"tokens": [[ids]], "n_new": K,
+                "temperature"?, "top_k"?, "top_p"?, "seed"?} -> sampled
+                continuation ids. Only models exposing generate() (the
+                transformer flagship; KV-cache decode) serve this route."""
+                try:
+                    payload = self._read_json()
+                    if not hasattr(server.model, "generate"):
+                        self._send(400, {"error": "model has no generate()"})
+                        return
+                    toks = np.asarray(payload["tokens"], np.int32)
+                    if toks.ndim == 1:
+                        toks = toks[None]
+                    # coerce filter args: JSON numbers often arrive as
+                    # floats, and a float top_k would both fail lax.top_k
+                    # and pollute the compile cache key
+                    tk = payload.get("top_k")
+                    tp = payload.get("top_p")
+                    out = server.generate(
+                        toks, int(payload.get("n_new", 16)),
+                        temperature=float(payload.get("temperature", 1.0)),
+                        seed=int(payload.get("seed", 0)),
+                        top_k=int(tk) if tk is not None else None,
+                        top_p=float(tp) if tp is not None else None,
+                    )
+                    self._send(200, {"tokens": out.tolist()})
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -95,6 +132,14 @@ class ModelServer:
             out = self.model.output(x)
         out0 = out[0] if isinstance(out, (list, tuple)) else out
         return np.asarray(out0)
+
+    def generate(self, tokens: np.ndarray, n_new: int, **kw) -> np.ndarray:
+        import jax.numpy as jnp
+
+        with self._lock:
+            out = self.model.generate(jnp.asarray(tokens, jnp.int32),
+                                      n_new, **kw)
+        return np.asarray(out)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ModelServer":

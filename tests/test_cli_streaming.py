@@ -225,3 +225,68 @@ class TestStreamingPipeline:
         pipe.stop()
         assert pipe.batches_fit == 4
         assert all(np.isfinite(l) for l in pipe.losses)
+
+
+class TestGenerateEndpoint:
+    def test_generate_route(self):
+        """POST /generate drives TransformerLM.generate (KV-cache decode)
+        through the serving surface."""
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+        from deeplearning4j_tpu.streaming.serving import ModelServer
+
+        lm = TransformerLM(TransformerConfig(
+            vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32,
+            max_len=16, use_flash=False))
+        srv = ModelServer(model=lm).start()
+        try:
+            body = json.dumps({"tokens": [1, 2, 3], "n_new": 4,
+                               "temperature": 0.7, "top_k": 5,
+                               "seed": 1}).encode()
+            req = urllib.request.Request(
+                srv.url + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out = json.loads(r.read())
+            assert len(out["tokens"][0]) == 4
+            assert all(0 <= t < 32 for t in out["tokens"][0])
+        finally:
+            srv.stop()
+
+    def test_generate_rejected_for_non_lm(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from deeplearning4j_tpu.nn.conf import (
+            DenseLayer,
+            NeuralNetConfiguration,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.streaming.serving import ModelServer
+
+        conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.1)
+                .list()
+                .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax"))
+                .build())
+        srv = ModelServer(model=MultiLayerNetwork(conf).init()).start()
+        try:
+            body = json.dumps({"tokens": [1], "n_new": 2}).encode()
+            req = urllib.request.Request(
+                srv.url + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "generate" in json.loads(e.read())["error"]
+        finally:
+            srv.stop()
