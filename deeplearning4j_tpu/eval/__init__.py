@@ -2,4 +2,5 @@ from deeplearning4j_tpu.eval.evaluation import (
     ConfusionMatrix,
     Evaluation,
     RegressionEvaluation,
+    ROC,
 )

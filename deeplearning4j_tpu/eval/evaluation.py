@@ -188,3 +188,72 @@ class RegressionEvaluation:
                 f"{self.r_squared(c):<10.5f}"
             )
         return "\n".join(lines)
+
+
+class ROC:
+    """Binary ROC / AUC (threshold sweep over predicted P(class 1)).
+
+    Beyond the 0.4-era reference (whose eval/ stops at Evaluation +
+    RegressionEvaluation; ROC arrived in later DL4J) but part of the eval
+    surface users coming from any dl4j version expect. Exact
+    trapezoidal AUC over the unique-score thresholds; merge() accumulates
+    raw (score, label) pairs so distributed evaluation reduces the same
+    way Evaluation.merge does."""
+
+    def __init__(self):
+        self._scores: List[float] = []
+        self._labels: List[int] = []
+
+    def eval(self, labels, probabilities) -> "ROC":
+        """labels: [N] 0/1 ints or [N, 2] one-hot; probabilities: [N]
+        P(positive) or [N, 2] class probabilities."""
+        labels = np.asarray(labels)
+        probs = np.asarray(probabilities, np.float64)
+        if labels.ndim == 2:
+            # (N, 1) column labels ARE the 0/1 values; only 2-column
+            # one-hot gets argmax (argmax of a column is silently all-0)
+            labels = (labels[:, 0] if labels.shape[1] == 1
+                      else labels.argmax(axis=1))
+        if probs.ndim == 2:
+            # (N, 1) sigmoid output IS P(positive); (N, 2) takes column 1
+            probs = probs[:, 0] if probs.shape[1] == 1 else probs[:, 1]
+        self._labels.extend(int(v) for v in labels)
+        self._scores.extend(float(v) for v in probs)
+        return self
+
+    def merge(self, other: "ROC") -> "ROC":
+        self._labels.extend(other._labels)
+        self._scores.extend(other._scores)
+        return self
+
+    def roc_curve(self):
+        """(fpr, tpr) arrays over descending score thresholds."""
+        if not self._labels:
+            return np.zeros(0), np.zeros(0)
+        y = np.asarray(self._labels)
+        s = np.asarray(self._scores)
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        s = s[order]
+        tps = np.cumsum(y)
+        fps = np.cumsum(1 - y)
+        # one operating point per unique threshold (last index of each run)
+        last = np.r_[np.nonzero(np.diff(s))[0], len(s) - 1]
+        tp, fp = tps[last], fps[last]
+        p = int(y.sum())
+        n = len(y) - p
+        if p == 0 or n == 0:
+            # single-class data: ROC is undefined (NOT 0.0 — an
+            # all-positive batch must not report worst-possible AUC)
+            return np.full(1, np.nan), np.full(1, np.nan)
+        return np.r_[0.0, fp / n], np.r_[0.0, tp / p]
+
+    def auc(self) -> float:
+        fpr, tpr = self.roc_curve()
+        if len(fpr) < 2 or np.isnan(fpr).any():
+            return float("nan")
+        return float(np.trapezoid(tpr, fpr))
+
+    def stats(self) -> str:
+        return (f"ROC: {len(self._labels)} examples, "
+                f"{int(np.sum(self._labels))} positive, AUC {self.auc():.4f}")

@@ -171,3 +171,4 @@ def test_local_file_saver_roundtrip(tmp_path):
     s1 = restored.score(ds.features, ds.labels)
     assert np.isfinite(s1)
     assert saver.get_latest_model() is not None
+

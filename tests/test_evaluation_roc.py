@@ -1,0 +1,62 @@
+"""ROC / AUC (eval surface — beyond the 0.4 reference's Evaluation)."""
+
+import numpy as np
+import pytest
+
+class TestROC:
+    def test_auc_perfect_and_random(self):
+        from deeplearning4j_tpu.eval import ROC
+
+        y = np.array([0, 0, 0, 1, 1, 1])
+        perfect = ROC().eval(y, np.array([.1, .2, .3, .7, .8, .9]))
+        assert perfect.auc() == 1.0
+        inverted = ROC().eval(y, np.array([.9, .8, .7, .3, .2, .1]))
+        assert inverted.auc() == 0.0
+        # ties at 0.5 for everything -> chance-level 0.5
+        flat = ROC().eval(y, np.full(6, 0.5))
+        assert flat.auc() == 0.5
+
+    def test_matches_sklearn_free_reference(self):
+        """Hand-checked AUC against the rank-statistic (Mann-Whitney U)
+        definition on a random set."""
+        from deeplearning4j_tpu.eval import ROC
+
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 200)
+        s = rng.random(200)
+        roc = ROC().eval(y, s)
+        pos = s[y == 1][:, None]
+        neg = s[y == 0][None, :]
+        u = (pos > neg).sum() + 0.5 * (pos == neg).sum()
+        expect = u / (len(pos) * neg.shape[1])
+        assert roc.auc() == pytest.approx(float(expect), abs=1e-9)
+
+    def test_merge_and_onehot_inputs(self):
+        from deeplearning4j_tpu.eval import ROC
+
+        y1 = np.eye(2)[[0, 1, 1]]
+        p1 = np.stack([[.8, .2], [.3, .7], [.4, .6]])
+        y2 = np.eye(2)[[0, 0, 1]]
+        p2 = np.stack([[.9, .1], [.6, .4], [.2, .8]])
+        a = ROC().eval(y1, p1)
+        b = ROC().eval(y2, p2)
+        merged = a.merge(b)
+        whole = ROC().eval(np.concatenate([y1, y2]),
+                           np.concatenate([p1, p2]))
+        assert merged.auc() == whole.auc() == 1.0
+        assert "AUC" in merged.stats()
+
+
+class TestROCEdgeShapes:
+    def test_column_labels_and_sigmoid_probs(self):
+        from deeplearning4j_tpu.eval import ROC
+
+        roc = ROC().eval(np.array([[0], [1], [1], [0]]),
+                         np.array([[.1], [.9], [.8], [.2]]))
+        assert roc.auc() == 1.0
+
+    def test_single_class_is_nan_not_zero(self):
+        from deeplearning4j_tpu.eval import ROC
+
+        assert np.isnan(ROC().eval([1, 1, 1], [.9, .8, .7]).auc())
+        assert np.isnan(ROC().eval([0, 0], [.1, .2]).auc())
