@@ -329,12 +329,23 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     return logits.astype(jnp.float32), aux / cfg.n_layers
 
 
+def nll_loss(logits: jax.Array, targets: jax.Array, mask=None) -> jax.Array:
+    """Mean next-token NLL — THE cross-entropy shared by the training
+    losses (dense/pipeline/ring) and evaluate(), so objective and metric
+    can never drift. mask ([N, T] 0/1): masked positions excluded from
+    numerator AND denominator."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return nll.mean()
+    m = mask.astype(jnp.float32)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
 def loss_fn(params: Params, tokens: jax.Array, targets: jax.Array,
             cfg: TransformerConfig) -> jax.Array:
     logits, aux = forward(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
-    return nll + cfg.moe_aux_coef * aux
+    return nll_loss(logits, targets) + cfg.moe_aux_coef * aux
 
 
 # ---------------------------------------------------------------------------
@@ -727,8 +738,7 @@ def _build_ring_step(cfg, mesh, strategy):
 
     def sp_loss(params, tokens, targets):
         logits = ring_forward(params, tokens, cfg, mesh, strategy=strategy)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+        return nll_loss(logits, targets)
 
     def step(params, opt, tokens, targets):
         loss, grads = jax.value_and_grad(sp_loss)(params, tokens, targets)
@@ -891,8 +901,7 @@ def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
         logits = pipeline_forward(params, tokens, cfg, mesh,
                                   n_micro=n_micro, axis=axis,
                                   data_axis=data_axis)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+        return nll_loss(logits, targets)
 
     def step(params, opt, tokens, targets):
         loss, grads = jax.value_and_grad(pp_loss)(params, tokens, targets)
@@ -1066,6 +1075,48 @@ class TransformerLM:
             if hasattr(iterator, "reset"):
                 iterator.reset()
         return self
+
+    def evaluate(self, iterator) -> Dict[str, float]:
+        """Held-out evaluation: mean next-token cross-entropy and
+        perplexity over an iterator of DataSets carrying token ids
+        ([N, T] features, next-ids labels — the fit_iterator layout).
+        The per-batch loss is jitted once and losses stay device-side
+        until ONE bulk readback (the evaluate(DataSetIterator) role —
+        reference MultiLayerNetwork.evaluate :2316 — for the flagship)."""
+        if getattr(self, "_eval_loss", None) is None:
+            cfg = self._run_cfg
+
+            @jax.jit
+            def eval_loss(params, tokens, targets, mask):
+                logits, _ = forward(params, tokens, cfg)
+                return nll_loss(logits, targets, mask)
+
+            self._eval_loss = eval_loss
+        losses, counts = [], []
+        for ds in iterator:
+            x = jnp.asarray(ds.features, jnp.int32)
+            y = jnp.asarray(ds.labels, jnp.int32)
+            # labels_mask (variable-length sequences): masked positions
+            # count in neither the loss nor the token total
+            m = ds.labels_mask if ds.labels_mask is not None \
+                else ds.features_mask
+            if m is None:
+                m_arr = jnp.ones(x.shape, jnp.float32)
+                counts.append(x.shape[0] * x.shape[1])
+            else:
+                m_arr = jnp.asarray(m, jnp.float32)
+                counts.append(float(np.asarray(m).sum()))
+            losses.append(self._eval_loss(self.params, x, y, m_arr))
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        if not losses:
+            return {"loss": float("nan"), "perplexity": float("nan"),
+                    "tokens": 0}
+        w = np.asarray(counts, np.float64)
+        ls = np.asarray(jnp.stack(losses), np.float64)  # ONE bulk readback
+        mean = float((ls * w).sum() / w.sum())
+        return {"loss": mean, "perplexity": float(np.exp(mean)),
+                "tokens": int(w.sum())}
 
     def logits(self, tokens: jax.Array) -> jax.Array:
         return forward(self.params, tokens, self._run_cfg)[0]

@@ -564,3 +564,57 @@ class TestAdamWAndClipping:
         c = [float(serial2.fit(x, y)) for _ in range(3)]
         d = [float(lms.fit(x, y)) for _ in range(3)]
         np.testing.assert_allclose(d, c, rtol=1e-4)
+
+
+class TestEvaluatePerplexity:
+    def test_perplexity_of_uniform_model_is_vocab_size(self):
+        """An untrained-but-uniform check: with zeroed params the logits
+        are constant, so loss == ln(V) and perplexity == V exactly."""
+        import pytest
+
+        from deeplearning4j_tpu.datasets.iterator import DataSet
+
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        lm.params = jax.tree_util.tree_map(jnp.zeros_like, lm.params)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, cfg.vocab_size, (4, cfg.max_len + 1))
+        ds = [DataSet(toks[:, :-1], toks[:, 1:])]
+        res = lm.evaluate(ds)
+        assert res["perplexity"] == pytest.approx(cfg.vocab_size, rel=1e-4)
+        assert res["tokens"] == 4 * cfg.max_len
+
+    def test_training_reduces_perplexity(self):
+        from deeplearning4j_tpu.datasets.iterator import DataSet
+
+        cfg = _cfg(learning_rate=1e-2)
+        lm = TransformerLM(cfg)
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, cfg.vocab_size, (8, cfg.max_len + 1))
+        x, y = toks[:, :-1], toks[:, 1:]
+        ds = [DataSet(x, y)]
+        before = lm.evaluate(ds)["perplexity"]
+        for _ in range(10):
+            lm.fit(jnp.asarray(x, jnp.int32), jnp.asarray(y, jnp.int32))
+        after = lm.evaluate(ds)["perplexity"]
+        assert after < before
+
+    def test_masked_positions_excluded(self):
+        """Pad positions count in neither the loss nor the token total."""
+        import pytest
+
+        from deeplearning4j_tpu.datasets.iterator import DataSet
+
+        cfg = _cfg()
+        lm = TransformerLM(cfg)
+        rng = np.random.default_rng(2)
+        toks = rng.integers(0, cfg.vocab_size, (2, cfg.max_len + 1))
+        x, y = toks[:, :-1].copy(), toks[:, 1:].copy()
+        mask = np.ones_like(x, np.float32)
+        mask[:, 8:] = 0.0
+        y_garbage = y.copy()
+        y_garbage[:, 8:] = 0  # garbage labels under the mask
+        res_a = lm.evaluate([DataSet(x, y, None, mask)])
+        res_b = lm.evaluate([DataSet(x, y_garbage, None, mask)])
+        assert res_a["tokens"] == 2 * 8
+        assert res_a["loss"] == pytest.approx(res_b["loss"], rel=1e-6)
