@@ -103,9 +103,11 @@ class CharRnn:
 
     # -- generation -------------------------------------------------------
     def sample(self, prime: str, length: int = 200, temperature: float = 1.0,
-               seed: int = 0) -> str:
+               seed: int = 0, top_k: int = 0) -> str:
         """Stream generation via rnn_time_step (reference
-        sampleCharactersFromNetwork pattern over rnnTimeStep :2152)."""
+        sampleCharactersFromNetwork pattern over rnnTimeStep :2152).
+        top_k > 0 restricts each draw to the k most likely characters
+        (the same filter surface as TransformerLM.generate)."""
         rng = np.random.default_rng(seed)
         self.net.rnn_clear_previous_state()
         eye = np.eye(self.vocab_size, dtype=np.float32)
@@ -121,6 +123,9 @@ class CharRnn:
             if temperature != 1.0:
                 logp = np.log(np.maximum(p, 1e-12)) / temperature
                 p = np.exp(logp - logp.max())
+            if top_k and top_k < p.size:
+                cutoff = np.partition(p, -top_k)[-top_k]
+                p = np.where(p >= cutoff, p, 0.0)
             p /= p.sum()
             ci = int(rng.choice(self.vocab_size, p=p))
             out.append(self.chars[ci])

@@ -193,3 +193,16 @@ class TestGoogLeNet:
         assert np.isfinite(loss)
         outs = net.output(x)
         assert len(outs) == 3 and outs[0].shape == (4, 10)
+
+
+def test_char_rnn_top_k_sampling():
+    """top_k=1 sampling is deterministic greedy regardless of seed."""
+    from deeplearning4j_tpu.models.char_rnn import CharRnn
+
+    text = "hello world, hello there! " * 8
+    m = CharRnn(text, lstm_size=16, num_layers=1, tbptt_length=8)
+    m.fit_text(text, epochs=1, batch=4, seq_len=16)
+    a = m.sample("he", length=20, top_k=1, seed=0)
+    b = m.sample("he", length=20, top_k=1, seed=99)
+    assert a == b
+    assert len(a) == 22
