@@ -40,10 +40,16 @@ class ConfusionMatrix:
 class Evaluation:
     """Multi-class classification metrics (reference eval/Evaluation.java)."""
 
-    def __init__(self, num_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None, top_n: int = 1):
         self.num_classes = num_classes
         self.label_names = labels
         self.confusion: Optional[ConfusionMatrix] = None
+        # top-N accuracy (later-DL4J Evaluation(topN) surface, beyond the
+        # 0.4 reference): counted from full prediction vectors at eval time
+        self.top_n = max(1, int(top_n))
+        self._topn_correct = 0
+        self._topn_total = 0
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -68,6 +74,13 @@ class Evaluation:
         guess = predictions.argmax(axis=-1)
         for a, g in zip(actual, guess):
             self.confusion.add(int(a), int(g))
+        if self.top_n > 1:
+            k = min(self.top_n, predictions.shape[-1])
+            topk = np.argpartition(-predictions, k - 1, axis=-1)[:, :k]
+            self._topn_correct += int((topk == actual[:, None]).any(-1).sum())
+        else:
+            self._topn_correct += int((guess == actual).sum())
+        self._topn_total += len(actual)
 
     # -- metrics ------------------------------------------------------------
     @property
@@ -102,8 +115,20 @@ class Evaluation:
         r = self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
+    def top_n_accuracy(self) -> float:
+        if self._topn_total == 0:
+            raise ValueError("no evaluations recorded")
+        return self._topn_correct / self._topn_total
+
     def merge(self, other: "Evaluation"):
         """Distributed-eval reduce (reference Evaluation.merge :795)."""
+        if other._topn_total and other.top_n != self.top_n:
+            raise ValueError(
+                f"cannot merge Evaluation(top_n={other.top_n}) into "
+                f"Evaluation(top_n={self.top_n}) — the summed counters "
+                "would blend different metrics")
+        self._topn_correct += other._topn_correct
+        self._topn_total += other._topn_total
         if other.confusion is None:
             return self
         if self.confusion is None:
@@ -117,6 +142,11 @@ class Evaluation:
         lines = [
             "==========================Scores========================================",
             f" Accuracy:  {self.accuracy():.4f}",
+        ]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: "
+                         f"{self.top_n_accuracy():.4f}")
+        lines += [
             f" Precision: {self.precision():.4f}",
             f" Recall:    {self.recall():.4f}",
             f" F1 Score:  {self.f1():.4f}",

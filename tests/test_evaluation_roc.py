@@ -60,3 +60,51 @@ class TestROCEdgeShapes:
 
         assert np.isnan(ROC().eval([1, 1, 1], [.9, .8, .7]).auc())
         assert np.isnan(ROC().eval([0, 0], [.1, .2]).auc())
+
+
+class TestTopNAccuracy:
+    def test_top_n_counts(self):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        y = np.eye(4)[[0, 1, 2, 3]]
+        # argmax right only for row 0; true class is 2nd-best for rows 1-2,
+        # dead last for row 3
+        p = np.array([
+            [.7, .1, .1, .1],
+            [.6, .4, .0, .0],
+            [.1, .5, .4, .0],
+            [.5, .3, .2, .0],
+        ])
+        e1 = Evaluation()
+        e1.eval(y, p)
+        assert e1.top_n_accuracy() == e1.accuracy() == 0.25
+        e2 = Evaluation(top_n=2)
+        e2.eval(y, p)
+        assert e2.top_n_accuracy() == 0.75
+        assert e2.accuracy() == 0.25  # top-1 metrics unchanged
+
+    def test_top_n_merges(self):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        y = np.eye(3)[[0, 1]]
+        p = np.array([[.5, .4, .1], [.4, .5, .1]])
+        a = Evaluation(top_n=2)
+        a.eval(y[:1], p[:1])
+        b = Evaluation(top_n=2)
+        b.eval(y[1:], p[1:])
+        a.merge(b)
+        assert a.top_n_accuracy() == 1.0
+
+    def test_mixed_top_n_merge_rejected_and_stats_surface(self):
+        from deeplearning4j_tpu.eval import Evaluation
+
+        y = np.eye(3)[[0, 1]]
+        p = np.array([[.5, .4, .1], [.4, .5, .1]])
+        a = Evaluation(top_n=2)
+        a.eval(y, p)
+        b = Evaluation()  # top_n=1
+        b.eval(y, p)
+        with pytest.raises(ValueError):
+            a.merge(b)
+        assert "Top-2 Accuracy" in a.stats()
+        assert "Top-" not in b.stats()
