@@ -124,8 +124,13 @@ class CharRnn:
                 logp = np.log(np.maximum(p, 1e-12)) / temperature
                 p = np.exp(logp - logp.max())
             if top_k and top_k < p.size:
-                cutoff = np.partition(p, -top_k)[-top_k]
-                p = np.where(p >= cutoff, p, 0.0)
+                # keep EXACTLY k entries even on probability ties at the
+                # k-th value (lax.top_k semantics, matching the flagship's
+                # TransformerLM._filter_logits)
+                keep = np.argpartition(p, -top_k)[-top_k:]
+                mask = np.zeros_like(p)
+                mask[keep] = 1.0
+                p = p * mask
             p /= p.sum()
             ci = int(rng.choice(self.vocab_size, p=p))
             out.append(self.chars[ci])

@@ -271,10 +271,14 @@ def _flash_ext_kernel(off_ref, q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
     m, l, acc = lax.fori_loop(0, t // block_k, body, (m0, l0, a0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # rows with NO visible key keep lse = log(1e-30) ~ -69; their output is
-    # exactly 0, so any cross-shard combination weight exp(lse - M) * 0 = 0
-    lse_ref[0] = jnp.broadcast_to(
-        (m_safe_final(m) + jnp.log(l_safe))[None, :], (8, l.shape[0]))
+    # rows with NO visible key emit lse = -inf (not a ~-69 sentinel): the
+    # ring combiner takes M = max over shard lse's, and a finite sentinel
+    # could dominate a real block whose visible logits all sit below it,
+    # collapsing the combined output toward the sentinel's zero o-block.
+    # -inf gets weight exp(-inf - M_safe) = 0 in the combiner — exact.
+    lse = jnp.where(jnp.isfinite(m),
+                    m_safe_final(m) + jnp.log(l_safe), -jnp.inf)
+    lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, l.shape[0]))
 
 
 def _flash_ext_raw(q, k, v, kb, off, *, interpret: bool):
@@ -353,7 +357,11 @@ def _flash_ext_bwd(interpret, res, gs):
         ki = j * _BLOCK_K + jnp.arange(_BLOCK_K)
         s = jnp.where((qi[:, None] + off[0] >= ki[None, :])[None], s,
                       -jnp.inf)
-        p = jnp.exp(s - lse[..., None])                 # invisible -> 0
+        # lse = -inf marks a no-visible-key row: p must be 0 there, and
+        # exp(-inf - -inf) would be nan — substitute a finite lse first
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.where(jnp.isfinite(s),
+                      jnp.exp(s - lse_safe[..., None]), 0.0)
         dv_j = jnp.einsum("bqk,bqd->bkd", p, g32)
         dp = jnp.einsum("bqd,bkd->bqk", g32, vs)
         ds = p * (dp - Dvec[..., None]

@@ -131,7 +131,10 @@ def _ring_attention_body(q, k, v, key_mask=None, *, causal: bool,
     (m, l, o, _, _, _), _ = lax.scan(
         step_fn, (m, l, o, k, v, km0), jnp.arange(n_dev)
     )
-    denom = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
+    # where-based safe denominator, NOT maximum(l, 1e-30): the division
+    # backward computes -o/denom^2 and (1e-30)^2 underflows f32 to 0,
+    # turning all-masked rows (l = 0, o = 0) into 0/0 = NaN grads
+    denom = jnp.moveaxis(jnp.where(l > 0, l, 1.0), 1, 2)[..., None]
     return (o / denom).astype(q.dtype)
 
 
@@ -189,7 +192,11 @@ def _ring_attention_body_flash(q, k, v, key_mask=None, *, causal: bool,
 
     (M, l, o, _, _, _), _ = lax.scan(
         step_fn, (M, l, o, kf, vf, km0), jnp.arange(n_dev))
-    out = o / jnp.maximum(l, 1e-30)[..., None]
+    # where-based safe denominator (see _ring_attention_body): with the
+    # kernel's lse = -inf for all-masked rows, l = 0 here, and a
+    # maximum(l, 1e-30) denominator NaNs the backward via (1e-30)^2
+    # f32 underflow in -o/denom^2
+    out = o / jnp.where(l > 0, l, 1.0)[..., None]
     return _unfold_heads(out, n, h).astype(q.dtype)
 
 

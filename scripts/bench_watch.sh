@@ -27,9 +27,22 @@ sys.exit(0 if "ok" in res else 1)
 '
 while true; do
   if timeout 180 python -c "$PROBE" 2>>bench_watch.log; then
-    echo "$(date -Is) tunnel ALIVE -> running full bench" >> bench_watch.log
+    # Two-pass capture (round-3 lesson): a short tunnel window must still
+    # yield ALL legs. Pass 1 = --quick (reduced steps, ~minutes/leg),
+    # persisted per-leg; pass 2 = full-length for quality numbers.
+    echo "$(date -Is) tunnel ALIVE -> quick pass" >> bench_watch.log
+    python bench.py --quick > BENCH_WATCH_QUICK.json 2>> bench_watch.log
+    rc=$?  # capture BEFORE any $(...) substitution can clobber $?
+    echo "$(date -Is) quick pass done exit=$rc; snapshotting" >> bench_watch.log
+    # snapshot only on success: on a startup failure BENCH_PARTIAL.json
+    # still holds a PRIOR round's data and must not be relabelled quick
+    if [ "$rc" -eq 0 ]; then
+      cp -f BENCH_PARTIAL.json BENCH_PARTIAL_QUICK.json 2>> bench_watch.log
+    fi
+    echo "$(date -Is) -> full bench" >> bench_watch.log
     python bench.py > BENCH_WATCH.json 2>> bench_watch.log
-    echo "$(date -Is) bench done exit=$?" >> bench_watch.log
+    rc=$?
+    echo "$(date -Is) bench done exit=$rc" >> bench_watch.log
     break
   fi
   echo "$(date -Is) tunnel down; sleeping 600s" >> bench_watch.log

@@ -275,6 +275,40 @@ class TestRingFlashComposition:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, err_msg=f"d{name}")
 
+    @pytest.mark.parametrize("use_flash", [True, False])
+    def test_all_masked_rows_zero_output_finite_grads(self, use_flash):
+        """Regression: a query row whose visible keys are ALL masked must
+        output exactly 0 with finite gradients. Guards two coupled fixes:
+        the ext kernel's lse = -inf (not a finite ~-69 sentinel) for
+        no-visible-key rows, and the ring combiner's where-based safe
+        denominator (maximum(l, 1e-30) NaNs the backward via (1e-30)^2
+        f32 underflow in -o/denom^2 when l = 0)."""
+        q, k, v = self._qkv(seed=8)
+        t = q.shape[1]
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        t_local = t // 4
+        km = np.ones((2, t), bool)
+        km[:, :t_local] = False  # first shard fully masked: causal rows
+        # 0..t_local-1 see no key at all
+        km = jnp.asarray(km)
+
+        out = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                     key_mask=km, use_flash=use_flash,
+                                     interpret=use_flash)
+        out = np.asarray(out)
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[:, :t_local], 0.0)
+
+        def loss(q, k, v):
+            o = ring_attention_sharded(q, k, v, mesh, causal=True,
+                                       key_mask=km, use_flash=use_flash,
+                                       interpret=use_flash)
+            return (o.astype(jnp.float32) ** 2).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for gi, name in zip(g, "qkv"):
+            assert np.isfinite(np.asarray(gi)).all(), f"d{name} non-finite"
+
     def test_mha_apply_ring_with_mask(self):
         """mha_apply on a seq mesh now supports key_mask (previously a
         ValueError): padded garbage cannot leak into valid positions."""
