@@ -31,14 +31,18 @@ while true; do
     # yield ALL legs. Pass 1 = --quick (reduced steps, ~minutes/leg),
     # persisted per-leg; pass 2 = full-length for quality numbers.
     echo "$(date -Is) tunnel ALIVE -> quick pass" >> bench_watch.log
+    touch .quick_pass_start
     python bench.py --quick > BENCH_WATCH_QUICK.json 2>> bench_watch.log
     rc=$?  # capture BEFORE any $(...) substitution can clobber $?
     echo "$(date -Is) quick pass done exit=$rc; snapshotting" >> bench_watch.log
-    # snapshot only on success: on a startup failure BENCH_PARTIAL.json
-    # still holds a PRIOR round's data and must not be relabelled quick
-    if [ "$rc" -eq 0 ]; then
+    # snapshot iff THIS quick pass wrote it (mtime check, not exit code):
+    # a startup failure must not relabel a PRIOR round's data as quick,
+    # but a mid-run kill must still save the legs that DID persist before
+    # the full bench restarts and rewrites BENCH_PARTIAL.json from empty
+    if [ BENCH_PARTIAL.json -nt .quick_pass_start ]; then
       cp -f BENCH_PARTIAL.json BENCH_PARTIAL_QUICK.json 2>> bench_watch.log
     fi
+    rm -f .quick_pass_start
     echo "$(date -Is) -> full bench" >> bench_watch.log
     python bench.py > BENCH_WATCH.json 2>> bench_watch.log
     rc=$?
