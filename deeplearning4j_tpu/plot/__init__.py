@@ -1,7 +1,18 @@
 """Dimensionality-reduction / visualization models — capability surface of
 the reference plot package (SURVEY.md section 2.1 "plot": Tsne exact +
-BarnesHutTsne over SPTree, 2,336 LoC)."""
+BarnesHutTsne over SPTree, plus the filter/weight and reconstruction
+renders of PlotFilters/ImageRender/MultiLayerNetworkReconstructionRender)."""
 
+from deeplearning4j_tpu.plot.filters import (
+    PlotFilters,
+    PlotFiltersIterationListener,
+    ReconstructionRender,
+    reconstruct,
+    render_image,
+)
 from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
 
-__all__ = ["Tsne", "BarnesHutTsne"]
+__all__ = [
+    "Tsne", "BarnesHutTsne", "PlotFilters", "PlotFiltersIterationListener",
+    "ReconstructionRender", "reconstruct", "render_image",
+]

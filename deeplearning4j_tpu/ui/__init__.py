@@ -17,6 +17,7 @@ from deeplearning4j_tpu.ui.components import (
     ChartStackedArea,
     ChartTimeline,
     ComponentTable,
+    ComponentImage,
     ComponentText,
     StyleChart,
     component_from_dict,
@@ -37,6 +38,7 @@ __all__ = [
     "ChartStackedArea",
     "ChartTimeline",
     "ComponentTable",
+    "ComponentImage",
     "ComponentText",
     "StyleChart",
     "component_from_dict",

@@ -507,6 +507,64 @@ class ComponentText(Component):
         )
 
 
+@_register
+@dataclass
+class ComponentImage(Component):
+    """Inline raster image (the PlotFilters/ImageRender display role,
+    reference plot/PlotFilters.java + ImageRender.java, rendered into the
+    component DSL instead of an AWT window): carries base64 PNG bytes so
+    exported pages stay fully self-contained."""
+
+    png_base64: str = ""
+    title: str = ""
+    scale: int = 1  # integer upscale for small filter tiles (CSS pixels)
+    width: int = 0   # source pixel dims (for the <img> size attributes)
+    height: int = 0
+
+    @classmethod
+    def from_array(cls, image, title: str = "", scale: int = 1):
+        """Build from a [H, W] / [H, W, 3/4] array ([0,1] floats or pixel
+        values) via plot.filters.image_png_bytes."""
+        import base64
+
+        import numpy as np
+
+        from deeplearning4j_tpu.plot.filters import image_png_bytes
+
+        a = np.asarray(image)
+        return cls(png_base64=base64.b64encode(
+            image_png_bytes(a)).decode("ascii"),
+            title=title, scale=scale,
+            width=int(a.shape[1]), height=int(a.shape[0]))
+
+    def to_dict(self):
+        return {
+            "component_type": type(self).__name__,
+            "title": self.title,
+            "png_base64": self.png_base64,
+            "scale": self.scale,
+            "width": self.width,
+            "height": self.height,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(title=d["title"], png_base64=d["png_base64"],
+                   scale=d.get("scale", 1), width=d.get("width", 0),
+                   height=d.get("height", 0))
+
+    def render(self) -> str:
+        w = self.width * self.scale or ""
+        h = self.height * self.scale or ""
+        dims = (f' width="{w}" height="{h}"' if w and h else "")
+        cap = (f'<div style="color:{TEXT_SECONDARY};font-size:12px">'
+               f"{html.escape(self.title)}</div>" if self.title else "")
+        return (f'{cap}<img src="data:image/png;base64,{self.png_base64}"'
+                f'{dims} style="image-rendering:pixelated;'
+                f'border:1px solid {GRID}" '
+                f'alt="{html.escape(self.title or "image")}">')
+
+
 def render_page(components: Sequence[Component], title: str = "DL4J-TPU") -> str:
     """Standalone static page (reference StaticPageUtil/staticpage.ftl) —
     fully self-contained, no external assets."""

@@ -1,0 +1,215 @@
+"""Filter-grid / reconstruction renders (reference plot/PlotFilters.java,
+ImageRender.java, MultiLayerNetworkReconstructionRender.java,
+plot/iterationlistener/PlotFiltersIterationListener.java) — the last
+SURVEY §2.1 plot row: mosaic assembly semantics, PNG round trip, the
+AE/RBM reconstruction path, and the periodic listener."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.plot import (
+    PlotFilters,
+    PlotFiltersIterationListener,
+    ReconstructionRender,
+    reconstruct,
+    render_image,
+)
+
+
+class TestPlotFilters:
+    def test_mosaic_shape_no_spacing(self):
+        filt = np.random.default_rng(0).random((6, 16))
+        pf = PlotFilters(filt, tile_shape=(2, 3), image_shape=(4, 4))
+        plot = pf.plot()
+        assert plot.shape == (8, 12)
+
+    def test_mosaic_tile_placement(self):
+        """Tile (r, c) holds filter r*cols + c, scaled to [0, 1] per tile
+        (PlotFilters.plotSection row-major order + scale :63-66)."""
+        filt = np.arange(12, dtype=np.float64).reshape(3, 4)  # 3 filters 2x2
+        pf = PlotFilters(filt, tile_shape=(2, 2), image_shape=(2, 2))
+        plot = pf.plot()
+        for i in range(3):
+            r, c = divmod(i, 2)
+            tile = plot[2 * r: 2 * r + 2, 2 * c: 2 * c + 2]
+            expect = (filt[i] - filt[i].min())
+            expect = (expect / expect.max()).reshape(2, 2)
+            np.testing.assert_allclose(tile, expect)
+        # unfilled 4th tile is zeros
+        np.testing.assert_array_equal(plot[2:, 2:], 0.0)
+
+    def test_spacing_inserts_gaps(self):
+        filt = np.ones((4, 4))
+        pf = PlotFilters(filt, tile_shape=(2, 2), image_shape=(2, 2),
+                         tile_spacing=(1, 1), scale_rows=False)
+        plot = pf.plot()
+        assert plot.shape == (5, 5)  # (2+1)*2-1
+        np.testing.assert_array_equal(plot[2, :], 0.0)  # gap row
+        np.testing.assert_array_equal(plot[:, 2], 0.0)  # gap col
+
+    def test_4d_input_stacks_channels(self):
+        x = np.random.default_rng(1).random((3, 4, 2, 2))
+        pf = PlotFilters(x, tile_shape=(2, 2), image_shape=(2, 2))
+        plot = pf.plot()
+        assert plot.shape == (4, 4, 3)
+
+    @pytest.mark.parametrize("channels,shape", [(1, (4, 4)), (2, (4, 4, 3)),
+                                                (4, (4, 4, 4))])
+    def test_4d_every_channel_count_renderable(self, channels, shape,
+                                               tmp_path):
+        """Every plot() result must feed render_image: 1 channel (the
+        MNIST conv case) squeezes to grayscale, 2 pads to RGB."""
+        x = np.random.default_rng(2).random((channels, 4, 2, 2))
+        pf = PlotFilters(x, tile_shape=(2, 2), image_shape=(2, 2))
+        plot = pf.plot()
+        assert plot.shape == shape
+        render_image(plot, str(tmp_path / "p.png"))
+
+    def test_get_plot_before_plot_raises(self):
+        pf = PlotFilters(np.ones((2, 4)), tile_shape=(1, 2),
+                         image_shape=(2, 2))
+        with pytest.raises(ValueError, match="plot"):
+            pf.get_plot()
+
+
+class TestRenderImage:
+    def test_png_round_trip_grayscale(self, tmp_path):
+        from PIL import Image
+
+        img = np.linspace(0, 1, 64).reshape(8, 8)
+        path = str(tmp_path / "g.png")
+        render_image(img, path)
+        back = np.asarray(Image.open(path))
+        assert back.shape == (8, 8)
+        np.testing.assert_array_equal(
+            back, np.clip(img * 255, 0, 255).astype(np.uint8))
+
+    def test_png_rgb(self, tmp_path):
+        from PIL import Image
+
+        img = np.random.default_rng(2).random((4, 4, 3))
+        path = str(tmp_path / "c.png")
+        render_image(img, path)
+        assert np.asarray(Image.open(path)).shape == (4, 4, 3)
+
+    def test_bad_shape_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="renderable"):
+            render_image(np.ones((2, 2, 2)), str(tmp_path / "x.png"))
+
+
+def _pretrain_net(layer_cls_kwargs):
+    from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .learning_rate(0.05)
+        .list()
+        .layer(0, layer_cls_kwargs)
+        .layer(1, OutputLayer(n_in=8, n_out=4, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestReconstruction:
+    @pytest.mark.parametrize("kind", ["ae", "rbm"])
+    def test_reconstruct_through_pretrain_layer(self, kind):
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder, RBM
+
+        layer = (AutoEncoder(n_in=16, n_out=8, activation="sigmoid")
+                 if kind == "ae" else
+                 RBM(n_in=16, n_out=8, visible_unit="binary",
+                     hidden_unit="binary"))
+        net = _pretrain_net(layer)
+        x = np.random.default_rng(3).random((5, 16)).astype(np.float32)
+        recon = reconstruct(net, x, 0)
+        assert recon.shape == (5, 16)
+        assert np.isfinite(recon).all()
+
+    def test_reconstruct_dense_layer_rejected(self):
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+
+        net = _pretrain_net(DenseLayer(n_in=16, n_out=8, activation="relu"))
+        with pytest.raises(ValueError, match="visible model"):
+            reconstruct(net, np.ones((2, 16), np.float32), 0)
+
+    def test_render_draw_writes_real_vs_recon(self, tmp_path):
+        from PIL import Image
+
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+
+        net = _pretrain_net(AutoEncoder(n_in=16, n_out=8,
+                                        activation="sigmoid"))
+        x = np.random.default_rng(4).random((6, 16)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[np.arange(6) % 4]
+        it = ListDataSetIterator(x, y, batch=6)
+        rr = ReconstructionRender(it, net, recon_layer=0, image_shape=(4, 4),
+                                  max_examples=6)
+        path = str(tmp_path / "recon.png")
+        mosaic = rr.draw(path)
+        assert mosaic.shape == (8, 24)  # 2 rows of six 4x4 images
+        assert np.asarray(Image.open(path)).shape == (8, 24)
+        # top row is the (scaled) real data, not all zeros
+        assert mosaic[:4].max() > 0
+
+    def test_draw_walks_the_iterator(self, tmp_path):
+        """Successive draw() calls render successive batches (reference
+        draw() walks iter.next() :46), and exhaustion raises."""
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+
+        net = _pretrain_net(AutoEncoder(n_in=16, n_out=8,
+                                        activation="sigmoid"))
+        rng = np.random.default_rng(5)
+        x = np.concatenate([np.zeros((2, 16), np.float32),
+                            rng.random((2, 16)).astype(np.float32)])
+        y = np.eye(4, dtype=np.float32)[np.arange(4) % 4]
+        rr = ReconstructionRender(ListDataSetIterator(x, y, batch=2), net,
+                                  recon_layer=0, image_shape=(4, 4))
+        m1 = rr.draw(str(tmp_path / "b0.png"))
+        m2 = rr.draw(str(tmp_path / "b1.png"))
+        # batch 0's real row is all-zero input; batch 1's is not
+        assert m1[:4].max() == 0.0
+        assert m2[:4].max() > 0.0
+        with pytest.raises(StopIteration):
+            rr.draw(str(tmp_path / "b2.png"))
+
+
+class TestPlotFiltersListener:
+    def test_listener_renders_every_n_iterations(self, tmp_path):
+        from deeplearning4j_tpu.datasets.fetchers import load_iris
+        from deeplearning4j_tpu.nn.conf.builder import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1)
+            .learning_rate(0.1)
+            .list()
+            .layer(0, DenseLayer(n_in=4, n_out=4, activation="tanh"))
+            .layer(1, OutputLayer(n_in=4, n_out=3, activation="softmax",
+                                  loss_function="mcxent"))
+            .build()
+        )
+        net = MultiLayerNetwork(conf)
+        net.init()
+        out = tmp_path / "render.png"
+        pf = PlotFilters(None, tile_shape=(2, 2), image_shape=(2, 2))
+        net.set_listeners(PlotFiltersIterationListener(
+            pf, layer=0, param="W", frequency=2, output_path=str(out)))
+        X, Y = load_iris()
+        for _ in range(2):
+            net.fit(X[:32], Y[:32])
+        assert out.exists()
+        # grid of layer-0 W^T: 4 filters of 4 weights as 2x2 tiles
+        from PIL import Image
+
+        assert np.asarray(Image.open(str(out))).shape == (4, 4)

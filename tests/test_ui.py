@@ -20,6 +20,7 @@ from deeplearning4j_tpu.ui import (
     ChartScatter,
     ChartStackedArea,
     ChartTimeline,
+    ComponentImage,
     ComponentTable,
     ComponentText,
     FlowIterationListener,
@@ -43,7 +44,9 @@ def all_components():
     tl = ChartTimeline(title="T").add_lane("w0", [(0, 10, "fit"), (10, 12, "avg")])
     table = ComponentTable(title="tab", header=["a", "b"], rows=[["1", "2"]])
     text = ComponentText(title="", text="hello")
-    return [line, scatter, hist, stacked, bars, tl, table, text]
+    img = ComponentImage.from_array(
+        np.linspace(0, 1, 16).reshape(4, 4), title="filters", scale=8)
+    return [line, scatter, hist, stacked, bars, tl, table, text, img]
 
 
 class TestComponentSerde:
@@ -56,15 +59,18 @@ class TestComponentSerde:
     def test_render_all_produce_markup(self):
         for comp in all_components():
             markup = comp.render()
-            assert ("<svg" in markup) or ("<table" in markup) or ("<p" in markup)
+            assert ("<svg" in markup) or ("<table" in markup) \
+                or ("<p" in markup) or ("<img" in markup)
 
     def test_static_page_export(self, tmp_path):
         page = render_page(all_components(), title="export test")
         assert page.count("<svg") >= 6
         assert "export test" in page
-        # self-contained: no external scripts/stylesheets/images
+        # self-contained: no external scripts/stylesheets/images (inline
+        # data: URIs — ComponentImage — are fine; http(s) refs are not)
         assert "<script" not in page and "<link" not in page
-        assert "src=" not in page
+        assert 'src="http' not in page
+        assert page.count('src="data:image/png;base64,') == 1
 
 
 class TestUiServer:
