@@ -84,6 +84,97 @@ class TrainingMaster:
         return None
 
 
+# -- exported-dataset plane (RDDTrainingApproach.Export role) ---------------
+
+_EXPORT_PREFIX = "dataset_"
+
+
+def export_datasets(iterator_or_datasets, dest: str,
+                    prefix: str = _EXPORT_PREFIX) -> List[str]:
+    """Serialize each DataSet minibatch to its own file — the reference's
+    export plumbing (ParameterAveragingTrainingMaster split/export,
+    :148-168, writing objects a later fit(String path) consumes,
+    SparkDl4jMultiLayer.fit:217). One npz per DataSet (the DataSet.save
+    role), named {prefix}{i:05d}.npz; dest is a local directory or a
+    gs:// prefix (staged locally, pushed via GcsUploader). Returns the
+    written paths/URIs."""
+    import os
+    import shutil
+    import tempfile
+
+    datasets = (list(iterator_or_datasets)
+                if not isinstance(iterator_or_datasets, (list, tuple))
+                else iterator_or_datasets)
+    is_gs = dest.startswith("gs://")
+    uploader = None
+    if is_gs:
+        from deeplearning4j_tpu.provision.gcs import GcsUploader
+
+        uploader = GcsUploader()
+        stage = tempfile.mkdtemp(prefix="dl4j_export_")
+    else:
+        stage = dest
+        os.makedirs(dest, exist_ok=True)
+    paths = []
+    try:
+        for i, ds in enumerate(datasets):
+            arrays = {"features": np.asarray(ds.features),
+                      "labels": np.asarray(ds.labels)}
+            if getattr(ds, "features_mask", None) is not None:
+                arrays["features_mask"] = np.asarray(ds.features_mask)
+            if getattr(ds, "labels_mask", None) is not None:
+                arrays["labels_mask"] = np.asarray(ds.labels_mask)
+            local = os.path.join(stage, f"{prefix}{i:05d}.npz")
+            np.savez(local, **arrays)
+            if is_gs:
+                uri = f"{dest.rstrip('/')}/{prefix}{i:05d}.npz"
+                uploader.upload(local, uri)
+                os.unlink(local)  # bound staging disk to one minibatch
+                paths.append(uri)
+            else:
+                paths.append(local)
+    finally:
+        if is_gs:
+            shutil.rmtree(stage, ignore_errors=True)
+    return paths
+
+
+def load_exported_datasets(path,
+                           prefix: str = _EXPORT_PREFIX) -> Iterable[DataSet]:
+    """Read DataSets back from an export location (the sc.binaryFiles +
+    deserialize step of fit(String path), SparkDl4jMultiLayer.java:217-221):
+    a local directory, an explicit list of files, or a gs:// prefix
+    (fetched through GcsDownloader's idempotent cache). Directory reads
+    match `prefix` so two exports into one directory under different
+    prefixes stay separate runs; files sort by name so the split order is
+    deterministic."""
+    import glob
+    import os
+    import tempfile
+
+    if isinstance(path, (list, tuple)):
+        files = sorted(path)
+    elif path.startswith("gs://"):
+        from deeplearning4j_tpu.provision.gcs import (
+            BucketIterator,
+            GcsDownloader,
+        )
+
+        dl = GcsDownloader(tempfile.mkdtemp(prefix="dl4j_fitpath_"))
+        files = sorted(dl.fetch(uri) for uri in BucketIterator(path))
+    else:
+        files = sorted(glob.glob(os.path.join(path, f"{prefix}*.npz")))
+    if not files:
+        raise ValueError(f"no exported datasets under {path!r}")
+    for f in files:
+        with np.load(f) as z:
+            yield DataSet(
+                z["features"], z["labels"],
+                z["features_mask"] if "features_mask" in z else None,
+                z["labels_mask"] if "labels_mask" in z else None,
+            )
+
+
 class ParameterAveragingTrainingMaster(TrainingMaster):
     """Host control plane over the device-side ParameterAveragingTrainer."""
 
@@ -233,6 +324,14 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def get_training_stats(self) -> Optional[TrainingStats]:
         return self.stats
 
+    def execute_training_paths(self, net, path) -> None:
+        """Fit from a previously exported location (the reference's
+        executeTraining(JavaPairRDD<String, PortableDataStream>) branch,
+        ParameterAveragingTrainingMaster.java:189-210, fed by
+        SparkDl4jMultiLayer.fit(String path) :217): deserialize the
+        exported DataSets, then run the same split/average loop."""
+        self.execute_training(net, load_exported_datasets(path))
+
 
 class DistributedEvaluator:
     """Map-reduce evaluation (EvaluateFlatMapFunction +
@@ -272,6 +371,12 @@ class SparkStyleNetwork:
 
     def fit(self, iterator_or_datasets) -> "SparkStyleNetwork":
         self.training_master.execute_training(self.net, iterator_or_datasets)
+        return self
+
+    def fit_paths(self, path) -> "SparkStyleNetwork":
+        """Fit from exported DataSet files — a directory, file list, or
+        gs:// prefix (SparkDl4jMultiLayer.fit(String path) :217)."""
+        self.training_master.execute_training_paths(self.net, path)
         return self
 
     def evaluate(self, datasets) -> Evaluation:

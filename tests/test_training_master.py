@@ -87,6 +87,105 @@ class TestBalancedSplits:
         assert [s.stop - s.start for s in sls] == [1, 1, 0, 0]
 
 
+class TestExportedSplitFit:
+    """Export-then-fit-from-path (reference export plumbing,
+    ParameterAveragingTrainingMaster.java:148-168 +
+    SparkDl4jMultiLayer.fit(String path) :217): saving an iterator's
+    minibatches as files and fitting from the path must train the SAME
+    model as fitting the iterator directly."""
+
+    def test_round_trip_preserves_datasets(self, tmp_path):
+        from deeplearning4j_tpu.parallel.training_master import (
+            export_datasets,
+            load_exported_datasets,
+        )
+
+        data = datasets_of(64, 16, seed=3)
+        # give one batch masks to prove they survive the round trip
+        data[1] = DataSet(data[1].features, data[1].labels,
+                          np.ones_like(data[1].features),
+                          np.ones_like(data[1].labels))
+        paths = export_datasets(data, str(tmp_path / "exp"))
+        assert len(paths) == 4
+        back = list(load_exported_datasets(str(tmp_path / "exp")))
+        assert len(back) == 4
+        for orig, re in zip(data, back):
+            np.testing.assert_array_equal(orig.features, re.features)
+            np.testing.assert_array_equal(orig.labels, re.labels)
+        assert back[0].features_mask is None
+        np.testing.assert_array_equal(back[1].features_mask,
+                                      np.ones_like(data[1].features))
+
+    def test_fit_paths_equals_direct_fit(self, tmp_path):
+        data = datasets_of(4 * 8 * 2 * 2, 32, seed=5)
+
+        def run(fit):
+            net = small_net()
+            master = ParameterAveragingTrainingMaster(
+                num_workers=4, batch_size_per_worker=8,
+                averaging_frequency=2,
+            )
+            fit(SparkStyleNetwork(net, master))
+            return net
+
+        from deeplearning4j_tpu.parallel.training_master import (
+            export_datasets,
+        )
+
+        export_datasets(data, str(tmp_path / "splits"))
+        net_direct = run(lambda s: s.fit(data))
+        net_paths = run(lambda s: s.fit_paths(str(tmp_path / "splits")))
+        for pd, pp in zip(net_direct.params, net_paths.params):
+            for k in pd:
+                np.testing.assert_allclose(
+                    np.asarray(pd[k]), np.asarray(pp[k]), atol=1e-7,
+                    err_msg=k)
+
+    def test_fit_paths_accepts_file_list(self, tmp_path):
+        from deeplearning4j_tpu.parallel.training_master import (
+            export_datasets,
+            load_exported_datasets,
+        )
+
+        paths = export_datasets(datasets_of(32, 16, seed=7),
+                                str(tmp_path / "lst"))
+        assert len(list(load_exported_datasets(paths))) == 2
+
+    def test_empty_path_raises(self, tmp_path):
+        from deeplearning4j_tpu.parallel.training_master import (
+            load_exported_datasets,
+        )
+
+        with pytest.raises(ValueError, match="no exported"):
+            list(load_exported_datasets(str(tmp_path)))
+
+    def test_gs_export_stages_and_uploads(self, tmp_path):
+        """gs:// destination goes through GcsUploader (fake runner — the
+        provision tests' pattern; no network)."""
+        import deeplearning4j_tpu.provision.gcs as gcs_mod
+        from deeplearning4j_tpu.parallel.training_master import (
+            export_datasets,
+        )
+
+        calls = []
+
+        class FakeUploader:
+            def upload(self, local, uri):
+                calls.append((local, uri))
+
+        orig = gcs_mod.GcsUploader
+        gcs_mod.GcsUploader = FakeUploader
+        try:
+            out = export_datasets(datasets_of(32, 16, seed=8),
+                                  "gs://bucket/exp")
+        finally:
+            gcs_mod.GcsUploader = orig
+        assert out == ["gs://bucket/exp/dataset_00000.npz",
+                       "gs://bucket/exp/dataset_00001.npz"]
+        assert len(calls) == 2
+        assert all(c[0].endswith(".npz") for c in calls)
+
+
 class TestParameterAveragingMaster:
     def test_training_reduces_score(self):
         net = small_net()
