@@ -19,15 +19,16 @@ reference gets from Hazelcast), and a file-based registry standing in for
 znodes. Multi-controller deployments point the registry at a shared
 filesystem and the semantics carry over.
 
-SCOPE NOTE (explicit, per round-2 review): the reference's
+SCOPE NOTE (revised round 4): the tracker's core is in-process, and the
+tensor data plane stays XLA collectives over ICI
+(parallel/{data,tensor,…}_parallel.py) with jax.distributed as multi-host
+control (parallel/multihost.py) — a host-side distributed KV store would
+duplicate what the runtime provides. But the reference's
 BaseHazelCastStateTracker.java:49 plane is genuinely CROSS-PROCESS
-(Hazelcast cluster members over TCP); this tracker is in-process BY
-DESIGN. On TPU the data plane that actually moves tensors is XLA
-collectives over ICI (parallel/{data,tensor,…}_parallel.py) and
-multi-host control is jax.distributed (parallel/multihost.py) — a
-host-side distributed KV store would duplicate what the runtime already
-provides. What this module preserves is the reference's CONTROL-PLANE
-SEMANTICS (queue/heartbeat/reclaim/routing), testable in one process.
+(Hazelcast members over TCP), so the control protocol is too:
+StateTrackerServer hosts a tracker on a TCP port and RemoteStateTracker
+drives the job-queue/heartbeat/reclaim protocol from other OS processes
+(exercised by a real multi-subprocess kill-and-reclaim test).
 """
 
 from __future__ import annotations
@@ -219,6 +220,188 @@ class IterativeReduceWorkRouter:
         merged = reduce_fn(results)
         self.tracker.set_params("merged", merged)
         return merged
+
+
+# ---------------------------------------------------------------------------
+# Cross-process transport (the Hazelcast TCP member plane)
+# ---------------------------------------------------------------------------
+
+
+_RPC_METHODS = frozenset({
+    "request_job", "complete_job", "fail_job", "heartbeat", "add_job",
+    "dead_workers", "reclaim_dead_jobs", "set_params", "get_params",
+    "counts", "results", "drain_results",
+})
+
+
+class StateTrackerServer:
+    """TCP host for a StateTracker — the part of
+    BaseHazelCastStateTracker.java:49 that is genuinely cross-process: the
+    master binds a port (the reference's Hazelcast member on :5701/:2181)
+    and workers in OTHER OS processes drive the job-queue/heartbeat/reclaim
+    protocol over it. Newline-delimited JSON RPC
+    ({"method": m, "args": [...]} -> {"ok": result} | {"err": msg});
+    payloads/results must be JSON values — tensors never ride this plane
+    (they move over ICI via the parallel/*_parallel.py data plane).
+
+    Publish the address for workers with FileServiceRegistry (the
+    zookeeper role), as the reference registers the Hazelcast host
+    (ZooKeeperConfigurationRegister)."""
+
+    def __init__(self, tracker: StateTracker, host: str = "127.0.0.1",
+                 port: int = 0):
+        import socketserver
+
+        self.tracker = tracker
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line)
+                        method = req["method"]
+                        if method not in _RPC_METHODS:
+                            raise ValueError(f"unknown method {method!r}")
+                        args = req.get("args", [])
+                        if method == "add_job":
+                            outer.tracker.add_job(Job(args[0], args[1]))
+                            resp = {"ok": None}
+                        elif method == "request_job":
+                            job = outer.tracker.request_job(args[0])
+                            resp = {"ok": None if job is None else
+                                    {"job_id": job.job_id,
+                                     "payload": job.payload,
+                                     "attempts": job.attempts}}
+                        else:
+                            resp = {"ok": getattr(outer.tracker, method)(
+                                *args)}
+                    except Exception as e:  # noqa: BLE001 — protocol error reply
+                        resp = {"err": f"{type(e).__name__}: {e}"}
+                    try:
+                        wire = json.dumps(resp)
+                    except TypeError as e:
+                        # non-JSON result (e.g. an ndarray set_params by an
+                        # in-process router): an error REPLY, not a dead
+                        # connection — tensors don't ride this plane
+                        wire = json.dumps(
+                            {"err": f"result not JSON-serializable: {e}"})
+                    self.wfile.write((wire + "\n").encode("utf-8"))
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "StateTrackerServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class RemoteStateTracker:
+    """Worker-side proxy: same surface as StateTracker, each call one JSON
+    RPC round trip to the master's StateTrackerServer (the reference
+    worker's Hazelcast client role). One persistent connection per proxy;
+    construct per process/thread."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        import socket
+
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+        self._broken = False
+
+    @classmethod
+    def from_address(cls, address: str, **kw) -> "RemoteStateTracker":
+        host, port = address.rsplit(":", 1)
+        return cls(host, int(port), **kw)
+
+    def _call(self, method: str, *args):
+        with self._lock:
+            if self._broken:
+                raise ConnectionError(
+                    "state tracker connection is broken (an earlier call "
+                    "timed out mid-reply; request/reply pairing is lost — "
+                    "reconnect with a new RemoteStateTracker)")
+            try:
+                self._sock.sendall(
+                    (json.dumps({"method": method, "args": list(args)})
+                     + "\n").encode("utf-8"))
+                line = self._rfile.readline()
+            except Exception:
+                # a timeout/partial read leaves the late reply queued on the
+                # socket: a retry would read the PREVIOUS call's reply and
+                # silently desync every later call — poison the connection
+                self._broken = True
+                self._sock.close()
+                raise
+        if not line:
+            raise ConnectionError("state tracker server closed connection")
+        resp = json.loads(line)
+        if "err" in resp:
+            raise RuntimeError(f"remote state tracker: {resp['err']}")
+        return resp["ok"]
+
+    def close(self) -> None:
+        self._sock.close()
+
+    # -- StateTracker surface over the wire --------------------------------
+    def add_job(self, job: Job) -> None:
+        self._call("add_job", job.job_id, job.payload)
+
+    def request_job(self, worker_id: str) -> Optional[Job]:
+        d = self._call("request_job", worker_id)
+        if d is None:
+            return None
+        return Job(d["job_id"], d["payload"], worker_id=worker_id,
+                   attempts=d["attempts"])
+
+    def complete_job(self, job_id: str, result: Any = None) -> None:
+        self._call("complete_job", job_id, result)
+
+    def fail_job(self, job_id: str) -> None:
+        self._call("fail_job", job_id)
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._call("heartbeat", worker_id)
+
+    def dead_workers(self) -> List[str]:
+        return self._call("dead_workers")
+
+    def reclaim_dead_jobs(self) -> int:
+        return self._call("reclaim_dead_jobs")
+
+    def set_params(self, key: str, value: Any) -> None:
+        self._call("set_params", key, value)
+
+    def get_params(self, key: str) -> Any:
+        return self._call("get_params", key)
+
+    def counts(self) -> Dict[str, int]:
+        return self._call("counts")
+
+    def results(self) -> Dict[str, Any]:
+        return self._call("results")
+
+    def drain_results(self) -> Dict[str, Any]:
+        return self._call("drain_results")
 
 
 # ---------------------------------------------------------------------------

@@ -161,7 +161,12 @@ def load_exported_datasets(path,
         )
 
         dl = GcsDownloader(tempfile.mkdtemp(prefix="dl4j_fitpath_"))
-        files = sorted(dl.fetch(uri) for uri in BucketIterator(path))
+        # same prefix/.npz filter as the local branch — co-located exports
+        # (or a checkpoint object under the prefix) must not leak in
+        uris = [u for u in BucketIterator(path)
+                if u.rsplit("/", 1)[-1].startswith(prefix)
+                and u.endswith(".npz")]
+        files = sorted(dl.fetch(uri) for uri in uris)
     else:
         files = sorted(glob.glob(os.path.join(path, f"{prefix}*.npz")))
     if not files:

@@ -123,3 +123,180 @@ class TestRegistry:
         assert reg.list_services() == ["master"]
         reg.unregister("master")
         assert reg.retrieve("master") is None
+
+
+# -------------------------------------------------- cross-process protocol
+WORKER_SCRIPT = r"""
+import sys, time
+from deeplearning4j_tpu.parallel.statetracker import RemoteStateTracker
+
+address, worker_id, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+t = RemoteStateTracker.from_address(address)
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    job = t.request_job(worker_id)
+    if job is None:
+        t.heartbeat(worker_id)
+        time.sleep(0.05)
+        continue
+    if mode == "hang":
+        # take the job, then die silently holding it (no heartbeat, no
+        # complete) — the failure the reclaim protocol must detect
+        time.sleep(3600)
+    time.sleep(job.payload.get("work_s", 0))
+    t.complete_job(job.job_id, {"worker": worker_id,
+                                "value": job.payload["n"] * 2})
+"""
+
+
+class TestCrossProcess:
+    """The reference Hazelcast plane is multi-process
+    (BaseHazelCastStateTracker.java:49); these tests run the queue/
+    heartbeat/reclaim protocol against REAL worker subprocesses over the
+    TCP transport, including a worker kill + job reclaim."""
+
+    @pytest.fixture()
+    def server(self):
+        from deeplearning4j_tpu.parallel.statetracker import (
+            StateTrackerServer,
+        )
+
+        tracker = StateTracker(heartbeat_timeout=1.0)
+        srv = StateTrackerServer(tracker).start()
+        yield srv
+        srv.stop()
+
+    def _spawn(self, tmp_path, address, worker_id, mode="work"):
+        import os
+        import subprocess
+        import sys
+
+        script = tmp_path / "worker.py"
+        if not script.exists():
+            script.write_text(WORKER_SCRIPT)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+        return subprocess.Popen(
+            [sys.executable, str(script), address, worker_id, mode],
+            env=env)
+
+    def _wait(self, cond, timeout=20.0, step=0.1):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(step)
+        return False
+
+    def test_two_subprocess_workers_complete_all_jobs(self, server,
+                                                      tmp_path):
+        procs = [self._spawn(tmp_path, server.address, f"w{i}")
+                 for i in range(2)]
+        try:
+            # both processes up (idle workers heartbeat) BEFORE work exists,
+            # and each job takes real time — else on this 1-core host the
+            # first worker drains the queue before the second even starts
+            assert self._wait(
+                lambda: len(server.tracker._heartbeats) == 2)
+            for i in range(8):
+                server.tracker.add_job(Job(f"job-{i}",
+                                           {"n": i, "work_s": 0.25}))
+            assert self._wait(
+                lambda: server.tracker.counts()["done"] == 8), \
+                server.tracker.counts()
+            results = server.tracker.results()
+            assert {r["value"] for r in results.values()} == {
+                2 * i for i in range(8)}
+            # both processes actually participated
+            assert len({r["worker"] for r in results.values()}) == 2
+        finally:
+            for p in procs:
+                p.kill()
+                p.wait()
+
+    def test_killed_worker_job_reclaimed_and_finished(self, server,
+                                                      tmp_path):
+        """Kill a worker holding a job: after heartbeat expiry the master
+        reclaims it and a surviving worker completes it (the ClearWorker
+        protocol the reference gets from Hazelcast membership)."""
+        server.tracker.add_job(Job("job-a", {"n": 1}))
+        hang = self._spawn(tmp_path, server.address, "hangw", mode="hang")
+        try:
+            assert self._wait(
+                lambda: server.tracker.counts()["assigned"] == 1)
+            hang.kill()
+            hang.wait()
+            # dead worker's heartbeat must expire, then reclaim re-queues
+            assert self._wait(
+                lambda: "hangw" in server.tracker.dead_workers(),
+                timeout=5)
+            assert server.tracker.reclaim_dead_jobs() == 1
+            good = self._spawn(tmp_path, server.address, "goodw")
+            try:
+                assert self._wait(
+                    lambda: server.tracker.counts()["done"] == 1)
+                res = server.tracker.results()["job-a"]
+                assert res == {"worker": "goodw", "value": 2}
+                # second delivery is recorded (attempts incremented)
+                assert server.tracker._done["job-a"].attempts == 2
+            finally:
+                good.kill()
+                good.wait()
+        finally:
+            if hang.poll() is None:
+                hang.kill()
+                hang.wait()
+
+    def test_remote_params_and_errors(self, server):
+        from deeplearning4j_tpu.parallel.statetracker import (
+            RemoteStateTracker,
+        )
+
+        t = RemoteStateTracker.from_address(server.address)
+        try:
+            t.set_params("merged", [1.5, 2.5])
+            assert t.get_params("merged") == [1.5, 2.5]
+            assert t.counts()["pending"] == 0
+            with pytest.raises(RuntimeError, match="unknown method"):
+                t._call("no_such_method")
+        finally:
+            t.close()
+
+
+    def test_non_json_result_yields_error_reply_not_dead_connection(
+            self, server):
+        import numpy as np
+
+        from deeplearning4j_tpu.parallel.statetracker import (
+            RemoteStateTracker,
+        )
+
+        server.tracker.set_params("merged", np.arange(3))  # in-process router
+        t = RemoteStateTracker.from_address(server.address)
+        try:
+            with pytest.raises(RuntimeError, match="not JSON-serializable"):
+                t.get_params("merged")
+            # connection survives: next call still works
+            assert t.counts()["pending"] == 0
+        finally:
+            t.close()
+
+    def test_timeout_poisons_connection(self, server):
+        from deeplearning4j_tpu.parallel.statetracker import (
+            RemoteStateTracker,
+        )
+
+        t = RemoteStateTracker.from_address(server.address, timeout=0.2)
+        try:
+            # stall the server so the reply misses the client deadline
+            orig = server.tracker.counts
+            server.tracker.counts = lambda: (time.sleep(0.6), orig())[1]
+            with pytest.raises(OSError):
+                t.counts()
+            server.tracker.counts = orig
+            # the connection is now poisoned, not silently desynced
+            with pytest.raises(ConnectionError, match="broken"):
+                t.heartbeat("w")
+        finally:
+            server.tracker.counts = orig
+            t.close()
