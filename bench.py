@@ -730,11 +730,12 @@ def measure(n_dev):
     float(loss)  # host readback: sound completion fence
     return batch * steps / (time.perf_counter() - t0)
 
-# best-of-2 per config, interleaved: on this shared 1-core host a single
-# rep's ratio swings 0.61-0.82 with background load (round-4 measurement);
-# the best-of pair is the least load-contaminated estimate
-t1 = max(measure(1), measure(1))
-t8 = max(measure(8), measure(8))
+# best-of-2 per config, INTERLEAVED 1,8,1,8: on this shared 1-core host a
+# single rep's ratio swings 0.61-0.82 with background load (round-4
+# measurement); interleaving means a load burst must span both configs to
+# bias the ratio, and max() drops the rep it landed on
+t1, t8 = measure(1), measure(8)
+t1, t8 = max(t1, measure(1)), max(t8, measure(8))
 print(json.dumps({"throughput_1dev": round(t1, 2), "throughput_8dev": round(t8, 2),
                   "dp_overhead_ratio": round(t8 / t1, 4)}))
 """
