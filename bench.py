@@ -879,7 +879,7 @@ def _probe_device(timeout_s: float = 180.0) -> Optional[str]:
                              "(remote-TPU tunnel down?)")
 
 
-def _run_isolated(name: str, quick: bool, timeout_s: int = 900,
+def _run_isolated(name: str, quick: bool, timeout_s: int = 0,
                   retries: int = 1):
     """Run one bench leg as `bench.py --only=name` in a FRESH subprocess.
 
@@ -888,7 +888,14 @@ def _run_isolated(name: str, quick: bool, timeout_s: int = 900,
     legs finished; same failure mode the north-star harness already guards
     against). A child process re-establishes the tunnel, the persistent
     compile cache keeps re-compiles cheap, and a timeout turns a wedge
-    into a reported error + one retry instead of a dead bench run."""
+    into a reported error + one retry instead of a dead bench run.
+
+    Quick mode uses a tighter deadline: a quick leg finishes in ~2-5 min
+    when the tunnel is healthy, so 2x900s on a wedged leg would burn a
+    short tunnel window (the round-4 03:47 contact lasted ~3 minutes and
+    the full 900s went to one wedged lenet5 attempt)."""
+    if not timeout_s:
+        timeout_s = 480 if quick else 900
     args = [sys.executable, os.path.abspath(__file__), f"--only={name}"]
     if quick:
         args.append("--quick")
@@ -917,16 +924,39 @@ _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _persist_partial(extras: dict) -> None:
-    """Append-as-you-go artifact: rewrite BENCH_PARTIAL.json after EVERY
+    """Append-as-you-go artifact: update BENCH_PARTIAL.json after EVERY
     completed leg so a mid-run tunnel outage preserves finished legs (the
     round-2 failure mode: the tunnel died mid-bench and the whole round's
     on-chip proof was lost). Atomic rename so a crash never leaves a
-    truncated artifact."""
+    truncated artifact.
+
+    MERGES across passes instead of rewriting: a leg that errored this
+    pass must never clobber a measured row from an earlier pass (round-4
+    incident: the tunnel died mid-quick-pass and a timed-out lenet5
+    retry overwrote the measured CPU legs at 04:08). A measured row
+    always replaces an older row; an error row only annotates a measured
+    row with last_error/last_error_ts."""
+    try:
+        with open(_PARTIAL_PATH) as f:
+            legs = json.load(f).get("legs", {})
+    except (OSError, ValueError):
+        legs = {}
+    for name, row in extras.items():
+        old = legs.get(name)
+        if (isinstance(row, dict) and "error" in row
+                and isinstance(old, dict) and "error" not in old):
+            old = dict(old)
+            old["last_error"] = row["error"]
+            old["last_error_ts"] = row.get("ts",
+                                           time.strftime("%Y-%m-%dT%H:%M:%S"))
+            legs[name] = old
+        else:
+            legs[name] = row
     tmp = _PARTIAL_PATH + ".tmp"
     try:
         with open(tmp, "w") as f:
             json.dump({"updated": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                       "legs": extras}, f, indent=1, sort_keys=True)
+                       "legs": legs}, f, indent=1, sort_keys=True)
         os.replace(tmp, _PARTIAL_PATH)
     except OSError as e:
         _log(f"partial artifact write failed: {e}")
@@ -999,20 +1029,26 @@ def main():
         except Exception as e:  # noqa: BLE001 — one broken bench must not kill the rest
             _log(f"FAILED {name}: {type(e).__name__}: {e}")
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
+        if isinstance(extras.get(name), dict):
+            # measurement provenance for the merged multi-pass artifact
+            extras[name].setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S"))
         _log(f"done {name} in {time.perf_counter() - t0:.1f}s")
         if not only:
             _persist_partial(extras)
 
+    # Leg ORDER is tunnel-window triage (round-4 lesson: the 03:47 contact
+    # lasted ~3 minutes): cheapest-compile highest-value first, so a short
+    # window still yields calibration + the headline config; CPU-only legs
+    # last (they don't need the window at all).
+    run("mxu_calibration", bench_mxu_calibration, steps=3 if quick else 10)
     run("lenet5", bench_lenet, steps=10 if quick else 30)
     run("lenet5_fused", bench_lenet_fused, reps=1 if quick else 3)
-    run("reference_cpu_lenet5_torch", bench_torch_lenet_cpu,
-        steps=3 if quick else 8)
     run("char_rnn", bench_char_rnn, steps=3 if quick else 10)
+    run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
+    run("transformer_lm", bench_transformer, steps=2 if quick else 5)
     run("resnet50", bench_resnet50, steps=3 if quick else 10)
     run("resnet50_bf16", bench_resnet50, steps=3 if quick else 10,
         dtype_policy="performance")
-    run("mxu_calibration", bench_mxu_calibration, steps=3 if quick else 10)
-    run("transformer_lm", bench_transformer, steps=2 if quick else 5)
     # MFU chase (VERDICT round-2 #7): the largest (d_model, batch) that
     # fits HBM with the blocked-flash backward — depth doubled vs the
     # round-2 best-MFU config (d2048 L4 b16 -> 0.110)
@@ -1023,10 +1059,11 @@ def main():
         steps=2 if quick else 3)
     run("flash_attention", bench_flash_attention, steps=3 if quick else 10)
     run("ring_attention", bench_ring_attention, steps=2 if quick else 5)
-    run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
     run("lstm_kernel", bench_lstm_kernel)
-    run("scaling_virtual8", bench_scaling)
     run("north_star", bench_north_star, steps=10 if quick else 100)
+    run("reference_cpu_lenet5_torch", bench_torch_lenet_cpu,
+        steps=3 if quick else 8)
+    run("scaling_virtual8", bench_scaling)
     if only:
         print(json.dumps(extras))
         return

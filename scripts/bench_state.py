@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Bench-artifact state checker for the tunnel watcher.
+
+`python scripts/bench_state.py <artifact.json>` exits 0 iff every expected
+bench leg has a measured (non-error) row in the artifact, else exits 1 and
+prints the gaps. Reads either schema:
+  BENCH_PARTIAL.json  -> {"updated": ..., "legs": {...}}
+  BENCH_WATCH*.json   -> {"metric": ..., "extras": {...}}
+
+The watcher uses this to decide whether another pass is still needed after
+a tunnel outage ate part of a run (round-4: the 03:47 contact lasted ~3
+minutes and the single-shot watcher would have stopped watching after one
+all-error pass).
+"""
+import json
+import sys
+
+# keep in sync with the run() calls in bench.py main()
+EXPECTED = [
+    "mxu_calibration", "lenet5", "lenet5_fused", "char_rnn",
+    "word2vec_sgns", "transformer_lm", "resnet50", "resnet50_bf16",
+    "transformer_lm_big", "flash_attention", "ring_attention",
+    "lstm_kernel", "north_star", "reference_cpu_lenet5_torch",
+    "scaling_virtual8",
+]
+
+
+def legs_of(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("legs") or data.get("extras") or {}
+
+
+def gaps(legs: dict) -> list:
+    out = []
+    for name in EXPECTED:
+        row = legs.get(name)
+        if not isinstance(row, dict) or "error" in row:
+            out.append(name)
+    return out
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_PARTIAL.json"
+    try:
+        missing = gaps(legs_of(path))
+    except (OSError, ValueError) as e:
+        print(f"unreadable {path}: {e}")
+        return 1
+    if missing:
+        print("missing/errored legs:", ", ".join(missing))
+        return 1
+    print("clean: all", len(EXPECTED), "legs measured")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

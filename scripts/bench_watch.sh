@@ -1,8 +1,12 @@
 #!/bin/bash
-# Tunnel watcher: poll the remote-TPU tunnel; the moment it's alive, run
-# the full bench (which persists BENCH_PARTIAL.json after every leg) and
-# capture the final JSON line. Round-2 lesson: the tunnel can be down for
-# hours and die mid-round — capture the proof the moment it's possible.
+# Tunnel watcher: poll the remote-TPU tunnel; whenever it's alive, run
+# bench passes until the artifact is complete. Round-2 lesson: the tunnel
+# can be down for hours and die mid-bench — capture the proof the moment
+# it's possible. Round-4 lesson (the 03:47 contact lasted ~3 minutes): one
+# quick+full shot is not enough; RE-ARM after every outage and keep
+# filling the gaps until BENCH_PARTIAL.json is clean. bench.py merges
+# per-leg results across passes, so each contact window only has to add
+# the legs still missing.
 cd /root/repo || exit 1
 # axon plugin registration needs /root/.axon_site on PYTHONPATH (CLAUDE.md);
 # without it jax silently falls back to CPU and the probe would loop forever
@@ -25,30 +29,50 @@ if "err" in res:
     print("probe error:", res["err"], file=sys.stderr)
 sys.exit(0 if "ok" in res else 1)
 '
+log() { echo "$(date -Is) $*" >> bench_watch.log; }
+
+full_passes=0
 while true; do
-  if timeout 180 python -c "$PROBE" 2>>bench_watch.log; then
-    # Two-pass capture (round-3 lesson): a short tunnel window must still
-    # yield ALL legs. Pass 1 = --quick (reduced steps, ~minutes/leg),
-    # persisted per-leg; pass 2 = full-length for quality numbers.
-    echo "$(date -Is) tunnel ALIVE -> quick pass" >> bench_watch.log
+  if ! timeout 180 python -c "$PROBE" 2>>bench_watch.log; then
+    log "tunnel down; sleeping 600s"
+    sleep 600
+    continue
+  fi
+  if ! python scripts/bench_state.py BENCH_PARTIAL.json >> bench_watch.log 2>&1; then
+    # --quick until every leg has a measured row: a short window must
+    # yield a COMPLETE (if reduced-step) 5-config artifact before any
+    # full-length pass hogs the tunnel.
+    log "tunnel ALIVE -> quick pass (filling gaps)"
     touch .quick_pass_start
     python bench.py --quick > BENCH_WATCH_QUICK.json 2>> bench_watch.log
-    rc=$?  # capture BEFORE any $(...) substitution can clobber $?
-    echo "$(date -Is) quick pass done exit=$rc; snapshotting" >> bench_watch.log
-    # snapshot iff THIS quick pass wrote it (mtime check, not exit code):
-    # a startup failure must not relabel a PRIOR round's data as quick,
-    # but a mid-run kill must still save the legs that DID persist before
-    # the full bench restarts and rewrites BENCH_PARTIAL.json from empty
+    log "quick pass exit=$?"
+    # snapshot iff THIS pass updated the artifact (mtime check): a
+    # startup failure must not relabel a prior pass's data as quick
     if [ BENCH_PARTIAL.json -nt .quick_pass_start ]; then
       cp -f BENCH_PARTIAL.json BENCH_PARTIAL_QUICK.json 2>> bench_watch.log
     fi
     rm -f .quick_pass_start
-    echo "$(date -Is) -> full bench" >> bench_watch.log
-    python bench.py > BENCH_WATCH.json 2>> bench_watch.log
-    rc=$?
-    echo "$(date -Is) bench done exit=$rc" >> bench_watch.log
-    break
+    continue  # re-probe, re-check state before going full-length
   fi
-  echo "$(date -Is) tunnel down; sleeping 600s" >> bench_watch.log
-  sleep 600
+  if [ "$full_passes" -lt 3 ] && ! python scripts/bench_state.py BENCH_WATCH.json >> bench_watch.log 2>&1; then
+    # Quick artifact is clean; upgrade to full-length numbers. Cap at 3
+    # attempts so a leg that legitimately fails at full length can't
+    # hold the tunnel forever (the merged quick rows remain the record).
+    log "-> full bench (attempt $((full_passes + 1)))"
+    python bench.py > BENCH_WATCH.json 2>> bench_watch.log
+    log "full bench exit=$?"
+    full_passes=$((full_passes + 1))
+    continue
+  fi
+  # Complete capture: run the word2vec device profile (VERDICT r03 #5,
+  # open since round 1) while the tunnel is still warm, then stop. The
+  # script writes W2V_PROFILE.json itself — stdout goes to a scratch
+  # file, NOT the artifact (two fds on one path garble it).
+  if [ ! -f W2V_PROFILE.json ]; then
+    log "-> word2vec device profile"
+    timeout 1800 python benchmarks/word2vec_profile.py > w2v_profile.out 2>> bench_watch.log \
+      || { log "w2v profile failed"; rm -f W2V_PROFILE.json; }
+  fi
+  log "capture complete (full_passes=$full_passes); watcher exiting"
+  break
 done
