@@ -962,8 +962,28 @@ def _persist_partial(extras: dict) -> None:
         _log(f"partial artifact write failed: {e}")
 
 
+def _load_partial_legs() -> dict:
+    try:
+        with open(_PARTIAL_PATH) as f:
+            return json.load(f).get("legs", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def _fill_skip(prev, quick: bool) -> bool:
+    """--fill decision: skip a leg whose existing row is measured (no
+    error) — except a FULL pass re-runs rows measured only at --quick
+    settings (3-step numbers must not stand in for 30-step numbers)."""
+    return (isinstance(prev, dict) and "error" not in prev
+            and (quick or not prev.get("quick")))
+
+
 def main():
     quick = "--quick" in sys.argv
+    # --fill: gap-filling mode for the tunnel watcher — skip legs that
+    # already have a measured (non-error) row in BENCH_PARTIAL.json so a
+    # short contact window is spent only on what's still missing
+    fill = "--fill" in sys.argv
     only = [a.split("=", 1)[1] for a in sys.argv if a.startswith("--only=")]
     # --trace[=DIR]: capture an xplane trace per leg (children inherit the
     # env; SURVEY section 5 profiling mapping — utils/profiling.py)
@@ -992,10 +1012,22 @@ def main():
     extras = {}
     if accel_down:
         extras["accelerator"] = {"error": f"unavailable: {probe_err}"}
+    elif not only:
+        # a healthy probe must CLEAR a stale outage row in the merged
+        # artifact (measured replaces error) — otherwise a fully-measured
+        # artifact would forever claim "accelerator unavailable"
+        extras["accelerator"] = {"ok": True,
+                                 "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+        _persist_partial(extras)
 
     def run(name, fn, *a, **kw):
         if only and name not in only:
             return
+        if fill and not only:
+            prev = _load_partial_legs().get(name)
+            if _fill_skip(prev, quick):
+                extras[name] = prev  # already measured this round — keep
+                return
         if accel_down and name not in _CPU_ONLY_LEGS:
             # still record the outage per-leg, and still run (and persist)
             # every CPU-only leg — a dead tunnel must not erase the parts
@@ -1030,8 +1062,12 @@ def main():
             _log(f"FAILED {name}: {type(e).__name__}: {e}")
             extras[name] = {"error": f"{type(e).__name__}: {e}"}
         if isinstance(extras.get(name), dict):
-            # measurement provenance for the merged multi-pass artifact
+            # measurement provenance for the merged multi-pass artifact:
+            # when it ran, and whether at reduced --quick settings (a full
+            # --fill pass re-measures quick rows; the judge can tell 3-step
+            # from 30-step numbers)
             extras[name].setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S"))
+            extras[name].setdefault("quick", bool(quick))
         _log(f"done {name} in {time.perf_counter() - t0:.1f}s")
         if not only:
             _persist_partial(extras)

@@ -73,10 +73,13 @@ class ParallelWrapper:
             return self._fit_graph(features, labels, mask, label_mask)
         b = np.asarray(features).shape[0]
         self._check_divisible(b)
-        x = jax.device_put(jnp.asarray(features), self.data_sharding)
-        y = jax.device_put(jnp.asarray(labels), self.data_sharding)
-        m = None if mask is None else jax.device_put(jnp.asarray(mask), self.data_sharding)
-        lm = None if label_mask is None else jax.device_put(jnp.asarray(label_mask), self.data_sharding)
+        from deeplearning4j_tpu.parallel.multihost import put_batch
+
+        x = put_batch(features, self.data_sharding)
+        y = put_batch(labels, self.data_sharding)
+        m = None if mask is None else put_batch(mask, self.data_sharding)
+        lm = (None if label_mask is None
+              else put_batch(label_mask, self.data_sharding))
         if net.conf.backprop_type == "truncated_bptt" and x.ndim == 3:
             return self._fit_tbptt_mln(x, y, m, lm)
         step = net._get_train_step(m is not None, lm is not None)
@@ -101,10 +104,12 @@ class ParallelWrapper:
         net = self.net
 
         def shard_stacked(a):
+            from deeplearning4j_tpu.parallel.multihost import put_batch
+
             a = jnp.asarray(a)
             self._check_divisible(a.shape[1])
             spec = P(*((None, DATA_AXIS) + (None,) * (a.ndim - 2)))
-            return jax.device_put(a, NamedSharding(self.mesh, spec))
+            return put_batch(a, NamedSharding(self.mesh, spec))
 
         if hasattr(net, "_as_inputs"):  # ComputationGraph
             feats = features if isinstance(features, (list, tuple)) else [features]
@@ -116,9 +121,16 @@ class ParallelWrapper:
         return net.fit_batches(shard_stacked(features), shard_stacked(labels))
 
     def _check_divisible(self, b: int) -> None:
-        if b % self.n != 0:
+        # multi-process runs feed the PROCESS-LOCAL shard (multihost
+        # .put_batch), so the divisibility bar is the local device share
+        n = self.n
+        pc = jax.process_count()
+        if pc > 1:
+            n = max(1, n // pc)
+        if b % n != 0:
             raise ValueError(
-                f"batch {b} not divisible by {self.n} devices "
+                f"batch {b} not divisible by {n} "
+                f"{'local ' if pc > 1 else ''}devices "
                 "(pad or trim — static shapes keep the step compiled once)"
             )
 
@@ -191,7 +203,12 @@ class ParallelWrapper:
                 f"expected {len(net.conf.outputs)} label arrays, got {len(labels_l)}"
             )
         self._check_divisible(next(iter(inputs.values())).shape[0])
-        put = lambda t: jax.device_put(t, self.data_sharding)
+        from deeplearning4j_tpu.parallel.multihost import put_batch
+
+        # process-local feeding under multi-process runs, same as the MLN
+        # path (plain device_put requires identical values on every
+        # process — put_batch docstring)
+        put = lambda t: put_batch(t, self.data_sharding)
         inputs = {k: put(v) for k, v in inputs.items()}
         labels_l = [put(l) for l in labels_l]
         masks_d = net._as_masks(masks)

@@ -13,9 +13,12 @@ minutes and the single-shot watcher would have stopped watching after one
 all-error pass).
 """
 import json
+import os
+import re
 import sys
 
-# keep in sync with the run() calls in bench.py main()
+# fallback only — expected_legs() derives the live list from bench.py's
+# run() calls so a new leg can't silently escape the completeness check
 EXPECTED = [
     "mxu_calibration", "lenet5", "lenet5_fused", "char_rnn",
     "word2vec_sgns", "transformer_lm", "resnet50", "resnet50_bf16",
@@ -23,6 +26,20 @@ EXPECTED = [
     "lstm_kernel", "north_star", "reference_cpu_lenet5_torch",
     "scaling_virtual8",
 ]
+
+_BENCH_PY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+def expected_legs() -> list:
+    """The single source of truth is bench.py's run("<leg>", ...) calls;
+    EXPECTED is only the fallback if bench.py is unreadable."""
+    try:
+        with open(_BENCH_PY) as f:
+            legs = re.findall(r'^\s*run\("([a-z0-9_]+)"', f.read(), re.M)
+        return legs or EXPECTED
+    except OSError:
+        return EXPECTED
 
 
 def legs_of(path: str) -> dict:
@@ -33,7 +50,7 @@ def legs_of(path: str) -> dict:
 
 def gaps(legs: dict) -> list:
     out = []
-    for name in EXPECTED:
+    for name in expected_legs():
         row = legs.get(name)
         if not isinstance(row, dict) or "error" in row:
             out.append(name)
@@ -50,7 +67,7 @@ def main() -> int:
     if missing:
         print("missing/errored legs:", ", ".join(missing))
         return 1
-    print("clean: all", len(EXPECTED), "legs measured")
+    print("clean: all", len(expected_legs()), "legs measured")
     return 0
 
 

@@ -8,6 +8,9 @@
 # per-leg results across passes, so each contact window only has to add
 # the legs still missing.
 cd /root/repo || exit 1
+# pidfile so restarts can kill the exact process (grep/pkill patterns
+# match the restarting shell's own args and kill the wrong process)
+echo $$ > .bench_watch.pid
 # axon plugin registration needs /root/.axon_site on PYTHONPATH (CLAUDE.md);
 # without it jax silently falls back to CPU and the probe would loop forever
 export PYTHONPATH="/root/repo:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
@@ -31,21 +34,51 @@ sys.exit(0 if "ok" in res else 1)
 '
 log() { echo "$(date -Is) $*" >> bench_watch.log; }
 
+# Round-start artifact hygiene: the merged artifacts must not carry a
+# PRIOR round's rows into this round's proof (a stale-but-clean
+# BENCH_PARTIAL.json would make --fill skip every leg and the watcher
+# declare capture complete without measuring anything). The round's
+# FIRST watcher launch creates .bench_round_start (CLAUDE.md: rm it at
+# round start before launching); artifacts older than the marker are
+# archived to *_prev.json. Mid-round watcher restarts keep the marker,
+# so the round's own rows survive.
+if [ ! -f .bench_round_start ]; then
+  touch .bench_round_start
+  # unconditional archive: every listed artifact predates the round by
+  # definition here (the marker is only absent at round start)
+  for f in BENCH_PARTIAL.json BENCH_PARTIAL_QUICK.json BENCH_WATCH.json \
+           BENCH_WATCH_QUICK.json W2V_PROFILE.json; do
+    if [ -f "$f" ]; then
+      mv -f "$f" "${f%.json}_prev.json"
+      log "archived stale $f -> ${f%.json}_prev.json (predates round start)"
+    fi
+  done
+fi
+
 full_passes=0
+quick_passes=0
+w2v_attempts=0
 while true; do
   if ! timeout 180 python -c "$PROBE" 2>>bench_watch.log; then
-    log "tunnel down; sleeping 600s"
-    sleep 600
+    # short windows are real (03:47 contact lasted ~3 min): poll fast
+    # enough that one can't fall entirely inside a sleep (a dead-tunnel
+    # probe itself burns up to 180s, so the full cycle is ~8 min)
+    log "tunnel down; sleeping 300s"
+    sleep 300
     continue
   fi
-  if ! python scripts/bench_state.py BENCH_PARTIAL.json >> bench_watch.log 2>&1; then
+  if [ "$quick_passes" -lt 5 ] && ! python scripts/bench_state.py BENCH_PARTIAL.json >> bench_watch.log 2>&1; then
     # --quick until every leg has a measured row: a short window must
     # yield a COMPLETE (if reduced-step) 5-config artifact before any
     # full-length pass hogs the tunnel.
-    log "tunnel ALIVE -> quick pass (filling gaps)"
+    # --fill re-runs only the legs still missing a measured row; capped
+    # at 5 so one deterministically-failing quick leg can't loop the
+    # watcher forever and never reach the full bench
+    log "tunnel ALIVE -> quick pass $((quick_passes + 1)) (filling gaps)"
     touch .quick_pass_start
-    python bench.py --quick > BENCH_WATCH_QUICK.json 2>> bench_watch.log
+    python bench.py --quick --fill > BENCH_WATCH_QUICK.json 2>> bench_watch.log
     log "quick pass exit=$?"
+    quick_passes=$((quick_passes + 1))
     # snapshot iff THIS pass updated the artifact (mtime check): a
     # startup failure must not relabel a prior pass's data as quick
     if [ BENCH_PARTIAL.json -nt .quick_pass_start ]; then
@@ -59,7 +92,9 @@ while true; do
     # attempts so a leg that legitimately fails at full length can't
     # hold the tunnel forever (the merged quick rows remain the record).
     log "-> full bench (attempt $((full_passes + 1)))"
-    python bench.py > BENCH_WATCH.json 2>> bench_watch.log
+    # --fill at full length: skips rows already measured FULL-length,
+    # re-measures rows that only have --quick numbers
+    python bench.py --fill > BENCH_WATCH.json 2>> bench_watch.log
     log "full bench exit=$?"
     full_passes=$((full_passes + 1))
     continue
@@ -68,11 +103,21 @@ while true; do
   # open since round 1) while the tunnel is still warm, then stop. The
   # script writes W2V_PROFILE.json itself — stdout goes to a scratch
   # file, NOT the artifact (two fds on one path garble it).
-  if [ ! -f W2V_PROFILE.json ]; then
-    log "-> word2vec device profile"
-    timeout 1800 python benchmarks/word2vec_profile.py > w2v_profile.out 2>> bench_watch.log \
-      || { log "w2v profile failed"; rm -f W2V_PROFILE.json; }
+  if [ ! -f W2V_PROFILE.json ] && [ "$w2v_attempts" -lt 3 ]; then
+    log "-> word2vec device profile (attempt $((w2v_attempts + 1)))"
+    w2v_attempts=$((w2v_attempts + 1))
+    timeout 1800 python benchmarks/word2vec_profile.py > w2v_profile.out 2>> bench_watch.log || true
+    # success test is the ARTIFACT, not the exit code: a 0-exit that
+    # wrote no file must also retry
+    if [ ! -f W2V_PROFILE.json ]; then
+      log "w2v profile failed; re-arming"
+      continue  # back to the probe — the tunnel may have died mid-profile
+    fi
   fi
-  log "capture complete (full_passes=$full_passes); watcher exiting"
+  if [ -f W2V_PROFILE.json ]; then
+    log "capture complete (full_passes=$full_passes quick=$quick_passes w2v=$w2v_attempts); watcher exiting"
+  else
+    log "capture ended WITHOUT w2v profile ($w2v_attempts attempts exhausted); watcher exiting"
+  fi
   break
 done

@@ -1,0 +1,114 @@
+"""Bench artifact plumbing: merge-across-passes persistence + the
+watcher's completeness checker.
+
+Round-4 regression cover: the tunnel died ~3 minutes into first contact
+and a timed-out retry leg OVERWROTE the measured rows in
+BENCH_PARTIAL.json (observed 2026-07-31 04:08). The reference keeps
+long-lived benchmark state out of scope (it publishes no numbers —
+BASELINE.md), so this contract is ours: an error row must never clobber a
+measured row; a fresh measured row always replaces an older one.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_persist_partial_merges_across_passes(tmp_path):
+    m = _load_bench()
+    m._PARTIAL_PATH = str(tmp_path / "partial.json")
+    # pass 1: a measured row
+    m._persist_partial({"lenet5": {"samples_per_sec": 100.0, "ts": "t1"}})
+    # pass 2: the tunnel died — error rows for both legs
+    m._persist_partial({"lenet5": {"error": "tunnel died", "ts": "t2"},
+                        "char_rnn": {"error": "down", "ts": "t2"}})
+    # pass 3: char_rnn measured on a later contact
+    m._persist_partial({"char_rnn": {"tokens_per_sec": 5.0, "ts": "t3"}})
+    legs = json.load(open(m._PARTIAL_PATH))["legs"]
+    # measured row survived the error pass, annotated not clobbered
+    assert legs["lenet5"]["samples_per_sec"] == 100.0
+    assert "error" not in legs["lenet5"]
+    assert legs["lenet5"]["last_error"] == "tunnel died"
+    assert legs["lenet5"]["last_error_ts"] == "t2"
+    # error row was upgraded to the later measured row
+    assert legs["char_rnn"] == {"tokens_per_sec": 5.0, "ts": "t3"}
+
+
+def test_fill_skip_semantics():
+    m = _load_bench()
+    measured_full = {"samples_per_sec": 10.0, "quick": False}
+    measured_quick = {"samples_per_sec": 10.0, "quick": True}
+    errored = {"error": "tunnel"}
+    # quick --fill: any measured row is good enough
+    assert m._fill_skip(measured_full, quick=True)
+    assert m._fill_skip(measured_quick, quick=True)
+    # full --fill: quick rows get re-measured at full length
+    assert m._fill_skip(measured_full, quick=False)
+    assert not m._fill_skip(measured_quick, quick=False)
+    # errors and gaps always re-run
+    assert not m._fill_skip(errored, quick=True)
+    assert not m._fill_skip(None, quick=True)
+    # legacy rows without the quick stamp count as full-length
+    assert m._fill_skip({"samples_per_sec": 1.0}, quick=False)
+
+
+def test_persist_partial_measured_replaces_measured(tmp_path):
+    m = _load_bench()
+    m._PARTIAL_PATH = str(tmp_path / "partial.json")
+    m._persist_partial({"lenet5": {"samples_per_sec": 100.0, "ts": "t1"}})
+    m._persist_partial({"lenet5": {"samples_per_sec": 250.0, "ts": "t2"}})
+    legs = json.load(open(m._PARTIAL_PATH))["legs"]
+    assert legs["lenet5"] == {"samples_per_sec": 250.0, "ts": "t2"}
+
+
+def _run_state(path):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_state.py"),
+         str(path)], capture_output=True, text=True)
+
+
+def test_bench_state_checker(tmp_path):
+    from scripts.bench_state import EXPECTED
+
+    p = tmp_path / "partial.json"
+    legs = {name: {"x": 1.0} for name in EXPECTED}
+    p.write_text(json.dumps({"legs": legs}))
+    assert _run_state(p).returncode == 0
+    # one leg errored -> incomplete
+    legs["resnet50"] = {"error": "oom"}
+    p.write_text(json.dumps({"legs": legs}))
+    r = _run_state(p)
+    assert r.returncode == 1 and "resnet50" in r.stdout
+    # one leg missing entirely -> incomplete
+    del legs["north_star"]
+    legs["resnet50"] = {"x": 1.0}
+    p.write_text(json.dumps({"legs": legs}))
+    r = _run_state(p)
+    assert r.returncode == 1 and "north_star" in r.stdout
+    # extras schema (BENCH_WATCH.json shape) is readable too
+    p.write_text(json.dumps(
+        {"metric": "m", "extras": {name: {"x": 1.0} for name in EXPECTED}}))
+    assert _run_state(p).returncode == 0
+
+
+def test_bench_state_expected_matches_bench_legs():
+    """The checker's EXPECTED list must track bench.py's run() calls —
+    a leg added to the bench but not the checker would let the watcher
+    declare victory without it."""
+    from scripts.bench_state import EXPECTED
+
+    src = open(os.path.join(REPO, "bench.py")).read()
+    import re
+    legs = re.findall(r'^\s*run\("([a-z0-9_]+)"', src, re.M)
+    assert sorted(legs) == sorted(EXPECTED)
