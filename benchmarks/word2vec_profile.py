@@ -107,8 +107,11 @@ def main(vocab=50_000, dim=128, batch=2048, k=5):
             f"NOT scatter-bound ({res['scatter_fraction']:.0%} of the "
             "step): the pallas scatter-add kernel is ruled out by "
             "measurement; gathers+math dominate and already ride XLA")
-    with open("W2V_PROFILE.json", "w") as f:
+    # atomic write: a timeout kill mid-dump must not leave a truncated
+    # artifact that the watcher's existence check would count as success
+    with open("W2V_PROFILE.json.tmp", "w") as f:
         json.dump(res, f, indent=1)
+    os.replace("W2V_PROFILE.json.tmp", "W2V_PROFILE.json")
     from deeplearning4j_tpu.ops.kernel_gate import record_win
 
     record_win("word2vec", "scatter_profile", res)

@@ -275,10 +275,12 @@ def _dense_block_f32(bp, h, n_heads: int, attend=None, ffn=None,
             + c(bp["b2"]))
 
 
-def _moe_ffn(bp, h, cfg: TransformerConfig):
+def _moe_ffn(bp, h, cfg: TransformerConfig, capacity: int = 0):
     """MoE FFN: routing + expert math shared with parallel/expert_parallel
     (called inline, not through its shard_map, so GSPMD shards the expert
-    dim via the param shardings; returns (out, aux_loss))."""
+    dim via the param shardings; returns (out, aux_loss)). capacity=0 ->
+    the standard formula; decode_step passes the NO-DROP capacity n*t so
+    one routing/expert body serves both batch and streamed paths."""
     from deeplearning4j_tpu.parallel.expert_parallel import (
         _routing,
         aux_loss_from_gates,
@@ -288,8 +290,9 @@ def _moe_ffn(bp, h, cfg: TransformerConfig):
     n, t, d = h.shape
     xt = h.reshape(n * t, d)
     gates = jax.nn.softmax((xt @ bp["Wg"]).astype(jnp.float32), axis=-1)
-    capacity = max(1, int(cfg.moe_capacity_factor * n * t * cfg.moe_top_k
-                          / cfg.moe_experts))
+    if not capacity:
+        capacity = max(1, int(cfg.moe_capacity_factor * n * t * cfg.moe_top_k
+                              / cfg.moe_experts))
     dispatch, combine = _routing(gates, cfg.moe_top_k, capacity)
     y = expert_mlp(bp["W1"], bp["b1"], bp["W2"], bp["b2"],
                    dispatch.astype(h.dtype), combine.astype(h.dtype), xt)
@@ -626,10 +629,10 @@ def prefill_cache(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     """Run the prompt through the model once, returning the per-layer K/V
     cache (leaves [L, N, max_len, H, hd]; positions beyond the prompt are
     garbage that decode's position mask never reads) plus the final hidden
-    states [N, T, d] (f32, post-final-LN). Mirrors forward()'s dense block
-    scan (same cast discipline); dense FFN only."""
-    if cfg.moe_experts:
-        raise NotImplementedError("KV-cache decoding supports dense FFN")
+    states [N, T, d] (f32, post-final-LN). Mirrors forward()'s block scan
+    (same cast discipline), including the MoE FFN branch — the prompt
+    routes with the standard capacity formula, so in the drop-free regime
+    prefill+decode is exactly the full forward."""
     cdt = cfg.compute_dtype
     n, t = tokens.shape
     hd = cfg.d_model // cfg.n_heads
@@ -646,7 +649,13 @@ def prefill_cache(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             captured["k"], captured["v"] = k, v
             return _attention(q, k, v, cfg.n_heads, use_flash=cfg.use_flash)
 
-        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, cdt=cdt)
+        if cfg.moe_experts:
+            bp16 = {kk: vv.astype(cdt) for kk, vv in bp.items()}
+            ffn = lambda x, bp16=bp16: _moe_ffn(bp16, x, cfg)[0]
+        else:
+            ffn = None
+        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn,
+                             cdt=cdt)
         pad = ((0, 0), (0, cfg.max_len - t), (0, 0), (0, 0))
         kc = jnp.pad(captured["k"].reshape(n, t, cfg.n_heads, hd), pad)
         vc = jnp.pad(captured["v"].reshape(n, t, cfg.n_heads, hd), pad)
@@ -657,13 +666,26 @@ def prefill_cache(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     return {"k": ks, "v": vs}, h
 
 
+def _moe_ffn_decode(bp, h, cfg: TransformerConfig) -> jax.Array:
+    """MoE FFN for one decode step (h: [N, 1, d]): _moe_ffn with NO-DROP
+    capacity — a streamed token only competes with the other N tokens of
+    its own step (each token holds at most one slot per expert), so
+    capacity = N makes decode drop-free. Matches the batch forward
+    exactly whenever the batch run is itself drop-free (capacity-bound
+    drops are inherently batch-vs-stream dependent — same boundary as any
+    capacity-routed MoE)."""
+    n, t, _ = h.shape
+    return _moe_ffn(bp, h, cfg, capacity=n * t)[0]
+
+
 def decode_step(params: Params, cache: Params, tok: jax.Array, pos,
                 cfg: TransformerConfig) -> Tuple[Params, jax.Array]:
     """One autoregressive step: consume the token at position `pos`
     (writing its K/V into the cache) and return (updated cache, logits for
     position pos+1). tok: [N] int32; pos: traced scalar. Attention reads
     the full max_len cache under an `arange <= pos` mask — O(max_len) per
-    token instead of the full forward's O(max_len^2)."""
+    token instead of the full forward's O(max_len^2). MoE blocks route
+    through _moe_ffn_decode (no-drop capacity)."""
     cdt = cfg.compute_dtype
     n = tok.shape[0]
     hd = cfg.d_model // cfg.n_heads
@@ -688,8 +710,12 @@ def decode_step(params: Params, cache: Params, tok: jax.Array, pos,
                          cv.astype(jnp.float32)).reshape(n, 1, cfg.d_model)
         h = h + att.astype(cdt) @ c(bp["Wo"])
         x = _ln(h, c(bp["ln2_g"]), c(bp["ln2_b"]))
-        h = h + jax.nn.gelu(x @ c(bp["W1"]) + c(bp["b1"])) @ c(bp["W2"]) \
-            + c(bp["b2"])
+        if cfg.moe_experts:
+            bp16 = {kk: c(vv) for kk, vv in bp.items()}
+            h = h + _moe_ffn_decode(bp16, x, cfg)
+        else:
+            h = h + jax.nn.gelu(x @ c(bp["W1"]) + c(bp["b1"])) @ c(bp["W2"]) \
+                + c(bp["b2"])
         return h, (ck, cv)
 
     h, (ks, vs) = lax.scan(block, h, (params["blocks"], cache["k"],
@@ -1279,9 +1305,9 @@ class TransformerLM:
         """Sample n_new tokens after the prompt (static shapes throughout:
         one compile per n_new). prompt len + n_new must fit max_len; longer
         prompts keep their last (max_len - n_new) tokens. use_cache:
-        KV-cache decoding (default on for dense single-device models —
-        O(max_len) per token); the full-forward sampler remains for MoE
-        and mesh-sharded models (and as the equivalence oracle)."""
+        KV-cache decoding (default on for single-device models, dense AND
+        MoE — O(max_len) per token); the full-forward sampler remains for
+        mesh-sharded models (and as the equivalence oracle)."""
         cfg = self._run_cfg
         if n_new >= cfg.max_len:
             raise ValueError(f"n_new {n_new} must be < max_len {cfg.max_len}")
@@ -1290,7 +1316,7 @@ class TransformerLM:
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p {top_p} must be in (0, 1]")
         if use_cache is None:
-            use_cache = self.mesh is None and not cfg.moe_experts
+            use_cache = self.mesh is None
         t = prompt.shape[1]
         keep = min(t, cfg.max_len - n_new)
         window = prompt[:, t - keep:]

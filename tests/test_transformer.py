@@ -395,6 +395,43 @@ class TestKVCacheDecoding:
         np.testing.assert_array_equal(np.asarray(out_kv),
                                       np.asarray(out_full))
 
+    def test_moe_generate_kv_equals_full(self):
+        """KV-cache decoding through MoE blocks (round-4: the former
+        'dense FFN only' rejection at prefill_cache). Drop-free regime
+        (capacity_factor = n_experts => capacity >= every possible expert
+        load), so batch routing == streamed routing and greedy decode
+        must match the full-forward sampler token-for-token."""
+        cfg = _cfg(moe_experts=4, d_ff=32, moe_capacity_factor=4.0)
+        lm = TransformerLM(cfg)
+        prompt = jnp.asarray([[5, 9, 2, 7], [1, 1, 3, 8]], jnp.int32)
+        out_kv = lm.generate(prompt, n_new=8, temperature=1e-8, seed=3,
+                             use_cache=True)
+        out_full = lm.generate(prompt, n_new=8, temperature=1e-8, seed=3,
+                               use_cache=False)
+        np.testing.assert_array_equal(np.asarray(out_kv),
+                                      np.asarray(out_full))
+
+    def test_moe_decode_step_matches_forward_logits(self):
+        from deeplearning4j_tpu.models.transformer import (
+            decode_step,
+            forward,
+            init_params,
+            prefill_cache,
+        )
+
+        cfg = _cfg(moe_experts=4, d_ff=32, moe_capacity_factor=4.0)
+        params = init_params(cfg)
+        rng = np.random.default_rng(1)
+        toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 6)),
+                           jnp.int32)
+        full_logits, _ = forward(params, toks, cfg)
+        cache, _ = prefill_cache(params, toks, cfg)
+        cache, logits = decode_step(params, cache, toks[:, 3],
+                                    jnp.asarray(3, jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full_logits[:, 3]),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_decode_step_matches_forward_logits(self):
         """decode_step at position p == forward()'s logits at p (the
         step-by-step equivalence underlying the sampler test)."""
