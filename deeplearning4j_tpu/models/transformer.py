@@ -299,6 +299,25 @@ def _moe_ffn(bp, h, cfg: TransformerConfig, capacity: int = 0):
     return y.reshape(n, t, d), aux_loss_from_gates(gates)
 
 
+def _moe_block(bp, h, cfg: TransformerConfig, *, attend=None, cdt,
+               capacity: int = 0):
+    """One transformer block with the MoE FFN: _dense_block_f32 with its
+    ffn override wired to _moe_ffn, returning (h, aux). The SINGLE
+    definition shared by the sequence-parallel (ring_forward), pipelined
+    (stage_fn), and KV-cache prefill paths — one place to change MoE cast
+    discipline or aux accounting."""
+    bp16 = {kk: vv.astype(cdt) for kk, vv in bp.items()}
+    cap = {}
+
+    def ffn(x):
+        y, cap["aux"] = _moe_ffn(bp16, x, cfg, capacity=capacity)
+        return y
+
+    h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn,
+                         cdt=cdt)
+    return h, cap["aux"]
+
+
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             ) -> Tuple[jax.Array, jax.Array]:
     """tokens [N, T] int32 -> (logits [N, T, V] f32, aux_loss scalar)."""
@@ -571,13 +590,17 @@ def make_train_multi_step(cfg: TransformerConfig,
 
 
 def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-                 mesh: Mesh, strategy: str = "ring") -> jax.Array:
+                 mesh: Mesh, strategy: str = "ring",
+                 return_aux: bool = False):
     """Forward with attention computed sequence-parallel over the 'seq'
     mesh axis (parallel/sequence_parallel.py): exact full attention for
     sequences sharded over devices. strategy='ring' rotates K/V shards via
     ppermute (memory-optimal for very long T); strategy='ulysses' uses two
     head<->sequence all_to_alls (fewer collectives; needs heads divisible
-    by the axis size). Used for long-context inference/eval."""
+    by the axis size). Long-context inference/eval, and (via
+    return_aux=True) the sequence-parallel TRAIN step: the MoE
+    load-balance aux loss is accumulated per block so SP training
+    optimizes the SAME objective as the serial step."""
     from deeplearning4j_tpu.parallel.sequence_parallel import (
         ring_attention_sharded,
         ulysses_attention_sharded,
@@ -603,17 +626,20 @@ def ring_forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     cdt = cfg.compute_dtype
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(cdt)
     L = params["blocks"]["Wq"].shape[0]
+    aux_total = jnp.zeros((), jnp.float32)
     for i in range(L):
         bp = jax.tree_util.tree_map(lambda a, i=i: a[i], params["blocks"])
         if cfg.moe_experts:
-            bp16 = {kk: vv.astype(cdt) for kk, vv in bp.items()}
-            ffn = lambda x, bp16=bp16: _moe_ffn(bp16, x, cfg)[0]
+            h, a = _moe_block(bp, h, cfg, attend=attend, cdt=cdt)
+            aux_total = aux_total + a
         else:
-            ffn = None
-        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn,
-                             cdt=cdt)
+            h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend,
+                                 cdt=cdt)
     h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
-    return (h @ params["embed"].T).astype(jnp.float32)
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    if return_aux:
+        return logits, aux_total / cfg.n_layers
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -650,12 +676,10 @@ def prefill_cache(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             return _attention(q, k, v, cfg.n_heads, use_flash=cfg.use_flash)
 
         if cfg.moe_experts:
-            bp16 = {kk: vv.astype(cdt) for kk, vv in bp.items()}
-            ffn = lambda x, bp16=bp16: _moe_ffn(bp16, x, cfg)[0]
+            h, _unused_aux = _moe_block(bp, h, cfg, attend=attend, cdt=cdt)
         else:
-            ffn = None
-        h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend, ffn=ffn,
-                             cdt=cdt)
+            h = _dense_block_f32(bp, h, cfg.n_heads, attend=attend,
+                                 cdt=cdt)
         pad = ((0, 0), (0, cfg.max_len - t), (0, 0), (0, 0))
         kc = jnp.pad(captured["k"].reshape(n, t, cfg.n_heads, hd), pad)
         vc = jnp.pad(captured["v"].reshape(n, t, cfg.n_heads, hd), pad)
@@ -753,18 +777,17 @@ def make_ring_train_step(cfg: TransformerConfig, mesh: Mesh, *,
 def _build_ring_step(cfg, mesh, strategy):
     # validated HERE so every sequence-parallel factory (single- and
     # multi-step) rejects the unsupported configs
-    if cfg.moe_experts:
-        raise NotImplementedError(
-            "sequence-parallel training supports dense FFN blocks (the MoE "
-            "aux loss is dropped by the ring forward path)")
     if cfg.accum_steps != 1:
         raise ValueError("cfg.accum_steps must be 1 under sequence-parallel "
                          "training (shard 'data' for more batch instead)")
     _validate_schedule(cfg)
 
     def sp_loss(params, tokens, targets):
-        logits = ring_forward(params, tokens, cfg, mesh, strategy=strategy)
-        return nll_loss(logits, targets)
+        # same objective as the serial loss_fn: NLL + the MoE aux term
+        # (aux == 0 for dense configs) — SP-train == serial-train
+        logits, aux = ring_forward(params, tokens, cfg, mesh,
+                                   strategy=strategy, return_aux=True)
+        return nll_loss(logits, targets) + cfg.moe_aux_coef * aux
 
     def step(params, opt, tokens, targets):
         loss, grads = jax.value_and_grad(sp_loss)(params, tokens, targets)
@@ -814,7 +837,8 @@ def make_ring_train_multi_step(cfg: TransformerConfig, mesh: Mesh, *,
 def pipeline_forward(params: Params, tokens: jax.Array,
                      cfg: TransformerConfig, mesh: Mesh, *,
                      n_micro: int, axis: str = PIPELINE_AXIS,
-                     data_axis: Optional[str] = None) -> jax.Array:
+                     data_axis: Optional[str] = None,
+                     return_aux: bool = False):
     """Forward with the LAYER STACK sharded over the mesh's 'pipe' axis
     (parallel/pipeline_parallel.py GPipe schedule): stage s holds layers
     [s*L/S, (s+1)*L/S); microbatches flow through the ring via ppermute.
@@ -822,37 +846,66 @@ def pipeline_forward(params: Params, tokens: jax.Array,
     are a small fraction of the params). Differentiable — jax.grad gives
     the backward pipeline via the scan/ppermute transposes. data_axis:
     optional PP x DP composition — each microbatch additionally sharded
-    over that mesh axis."""
+    over that mesh axis. MoE blocks route per group (see the stage_fn
+    note below); return_aux=True also returns the grouped load-balance
+    aux loss for the pipelined TRAIN objective."""
     from deeplearning4j_tpu.parallel.pipeline_parallel import pipeline_apply
 
     n_stages = mesh.shape[axis]
     L = cfg.n_layers
     if L % n_stages != 0:
         raise ValueError(f"n_layers {L} not divisible by {n_stages} stages")
-    if cfg.moe_experts:
-        raise NotImplementedError("pipeline_forward supports dense FFN blocks")
     per = L // n_stages
     # restack block leaves [L, ...] -> [S, per, ...] (stage-major)
     stage_params = jax.tree_util.tree_map(
         lambda a: a.reshape((n_stages, per) + a.shape[1:]), params["blocks"])
 
     cdt = cfg.compute_dtype
+    moe = bool(cfg.moe_experts)
 
-    def stage_fn(sp, h):
-        def block(h, bp):
-            return _dense_block_f32(bp, h, cfg.n_heads, cdt=cdt), None
+    if moe:
+        # MoE under GPipe routes PER GROUP (group = one microbatch, or one
+        # microbatch x data-slice under PP x DP) — the GShard/Switch group
+        # semantics: capacity and load-balance statistics are computed over
+        # the tokens that are physically together. With n_micro=1 this is
+        # exactly the serial batch objective; with n_micro>1 it is the
+        # grouped objective deployed MoE systems train (drop-free logits
+        # still match serial bit-for-bit).
+        def stage_fn(sp, h):
+            def block(carry, bp):
+                h, aux = carry
+                h, a = _moe_block(bp, h, cfg, cdt=cdt)
+                return (h, aux + a), None
 
-        h, _ = lax.scan(block, h, sp)
-        return h
+            (h, aux), _ = lax.scan(
+                block, (h, jnp.zeros((), jnp.float32)), sp)
+            return h, aux
+    else:
+        def stage_fn(sp, h):
+            def block(h, bp):
+                return _dense_block_f32(bp, h, cfg.n_heads, cdt=cdt), None
+
+            h, _ = lax.scan(block, h, sp)
+            return h
 
     n, t = tokens.shape
     # bf16 policy: the residual stream (the thing the ring ppermutes each
     # tick) is carried in the compute dtype — half the ICI traffic
     h = (params["embed"][tokens] + params["pos"][:t][None]).astype(cdt)
-    h = pipeline_apply(stage_params, h, mesh, stage_fn=stage_fn,
-                       n_micro=n_micro, axis=axis, data_axis=data_axis)
+    out = pipeline_apply(stage_params, h, mesh, stage_fn=stage_fn,
+                         n_micro=n_micro, axis=axis, data_axis=data_axis,
+                         with_aux=moe)
+    if moe:
+        h, aux = out
+        # mean aux per layer per group (serial forward's /L, M=1 => equal)
+        aux = aux / (cfg.n_layers * n_micro)
+    else:
+        h, aux = out, jnp.zeros((), jnp.float32)
     h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
-    return (h @ params["embed"].T).astype(jnp.float32)
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -914,20 +967,20 @@ def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
     # validated HERE so every pipelined factory (single- and multi-step)
     # rejects the unsupported configs, not just make_pipeline_train_step
     _validate_schedule(cfg)
-    if cfg.moe_experts:
-        raise NotImplementedError(
-            "pipelined training supports dense FFN blocks (MoE routing is "
-            "batch-statistic dependent across microbatches)")
     if cfg.accum_steps != 1:
         raise ValueError(
             "cfg.accum_steps must be 1 under pipelined training — n_micro "
             "IS the microbatch/accumulation count (the GPipe schedule)")
 
     def pp_loss(params, tokens, targets):
-        logits = pipeline_forward(params, tokens, cfg, mesh,
-                                  n_micro=n_micro, axis=axis,
-                                  data_axis=data_axis)
-        return nll_loss(logits, targets)
+        # same shape as the serial loss_fn (NLL + aux; aux == 0 dense).
+        # MoE aux is the GROUPED objective (group = microbatch): exactly
+        # the serial objective at n_micro=1, the GShard/Switch grouped
+        # objective at n_micro > 1.
+        logits, aux = pipeline_forward(params, tokens, cfg, mesh,
+                                       n_micro=n_micro, axis=axis,
+                                       data_axis=data_axis, return_aux=True)
+        return nll_loss(logits, targets) + cfg.moe_aux_coef * aux
 
     def step(params, opt, tokens, targets):
         loss, grads = jax.value_and_grad(pp_loss)(params, tokens, targets)
@@ -1305,9 +1358,12 @@ class TransformerLM:
         """Sample n_new tokens after the prompt (static shapes throughout:
         one compile per n_new). prompt len + n_new must fit max_len; longer
         prompts keep their last (max_len - n_new) tokens. use_cache:
-        KV-cache decoding (default on for single-device models, dense AND
-        MoE — O(max_len) per token); the full-forward sampler remains for
-        mesh-sharded models (and as the equivalence oracle)."""
+        KV-cache decoding (O(max_len) per token) — default on for DENSE
+        single-device models; the full-forward sampler remains the
+        default for mesh-sharded models and for MoE (where capacity-bound
+        routing is batch-vs-stream dependent: KV decode routes each step
+        as its own no-drop group, which matches the batch forward only in
+        the drop-free regime — pass use_cache=True to opt in)."""
         cfg = self._run_cfg
         if n_new >= cfg.max_len:
             raise ValueError(f"n_new {n_new} must be < max_len {cfg.max_len}")
@@ -1316,7 +1372,10 @@ class TransformerLM:
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p {top_p} must be in (0, 1]")
         if use_cache is None:
-            use_cache = self.mesh is None
+            # MoE stays opt-in: flipping it on by default would silently
+            # change sampled tokens for capacity-bound configs (the
+            # default moe_capacity_factor=1.25 regime)
+            use_cache = self.mesh is None and not cfg.moe_experts
         t = prompt.shape[1]
         keep = min(t, cfg.max_len - n_new)
         window = prompt[:, t - keep:]

@@ -52,9 +52,16 @@ def shard_pipeline_params(params: Any, mesh: Mesh,
 
 
 def _pipeline_body(params: Any, x: jax.Array, *, stage_fn: StageFn,
-                   n_micro: int, axis: str):
+                   n_micro: int, axis: str, with_aux: bool = False,
+                   data_axis: Optional[str] = None):
     """Per-device body. params leaves: [1, ...] (my stage, leading dim kept
-    by shard_map); x: [M, mb, ...] microbatched input, replicated."""
+    by shard_map); x: [M, mb, ...] microbatched input, replicated.
+
+    with_aux: stage_fn returns (y, aux_scalar) and the body additionally
+    returns the aux SUM over every valid (stage, microbatch) pair — the
+    per-group MoE load-balance statistics (group = microbatch, or
+    microbatch x data-slice under PP x DP), psum'd over the pipe axis and
+    pmean'd over the data axis so the scalar is replicated."""
     my_params = jax.tree_util.tree_map(lambda a: a[0], params)
     stage = lax.axis_index(axis)
     n_stages = lax.psum(1, axis)
@@ -62,40 +69,54 @@ def _pipeline_body(params: Any, x: jax.Array, *, stage_fn: StageFn,
 
     outputs = jnp.zeros_like(x)
     recv = jnp.zeros_like(x[0])
+    aux0 = jnp.zeros((), jnp.float32)
     # ring hop: stage s -> s+1 (last stage's send is dropped into stage 0's
     # recv buffer, where it is ignored — stage 0 reads from x instead)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def tick(carry, t):
-        recv, outputs = carry
+        recv, outputs, aux_sum = carry
         mb_idx = jnp.clip(t - stage, 0, n_micro - 1)
         inp = jnp.where(stage == 0,
                         lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1),
                                                  keepdims=False),
                         recv)
-        y = stage_fn(my_params, inp)
+        if with_aux:
+            y, aux = stage_fn(my_params, inp)
+        else:
+            y, aux = stage_fn(my_params, inp), aux0
         valid = (t - stage >= 0) & (t - stage < n_micro)
         outputs = jnp.where(
             valid,
             lax.dynamic_update_index_in_dim(outputs, y, mb_idx, 0),
             outputs,
         )
+        # bubble ticks compute garbage — their aux must not enter the sum
+        aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
         recv = lax.ppermute(y, axis, perm)
-        return (recv, outputs), None
+        return (recv, outputs, aux_sum), None
 
-    (_, outputs), _ = lax.scan(tick, (recv, outputs), jnp.arange(n_ticks))
+    (_, outputs, aux_sum), _ = lax.scan(
+        tick, (recv, outputs, aux0), jnp.arange(n_ticks))
     # only the LAST stage's output buffer is the model output; mask + psum
     # replicates it to every device
-    return lax.psum(
+    out = lax.psum(
         jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
         axis,
     )
+    if not with_aux:
+        return out
+    aux_total = lax.psum(aux_sum, axis)  # every stage's own layers
+    if data_axis is not None:
+        aux_total = lax.pmean(aux_total, data_axis)
+    return out, aux_total
 
 
 def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
                    stage_fn: StageFn, n_micro: int,
                    axis: str = PIPELINE_AXIS,
-                   data_axis: Optional[str] = None) -> jax.Array:
+                   data_axis: Optional[str] = None,
+                   with_aux: bool = False):
     """Run the pipelined model.
 
     params: pytree with leading stage dim [S, ...] on every leaf (S = pipe
@@ -108,6 +129,10 @@ def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
             schedule is unchanged: the ppermute ring runs over `axis`
             independently per data slice, so every (pipe, data) device
             pipelines its own batch shard).
+    with_aux: stage_fn returns (y, aux_scalar); pipeline_apply then returns
+            (output, aux_sum) where aux_sum totals every (stage, microbatch)
+            group's scalar (replicated) — the MoE per-group load-balance
+            statistics channel.
     Returns [B, ...] output, replicated over `axis` (sharded over
     `data_axis` when given)."""
     s = mesh.shape[axis]
@@ -133,12 +158,16 @@ def pipeline_apply(params: Any, x: jax.Array, mesh: Mesh, *,
     x_spec = (P(None, data_axis, *(None,) * (xm.ndim - 2))
               if data_axis is not None else P())
     fn = shard_map(
-        partial(_pipeline_body, stage_fn=stage_fn, n_micro=n_micro, axis=axis),
+        partial(_pipeline_body, stage_fn=stage_fn, n_micro=n_micro,
+                axis=axis, with_aux=with_aux, data_axis=data_axis),
         mesh=mesh,
         in_specs=(param_specs, x_spec),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()) if with_aux else x_spec,
         check_vma=False,
     )
+    if with_aux:
+        out, aux = fn(params, xm)
+        return out.reshape((b,) + out.shape[2:]), aux
     out = fn(params, xm)
     return out.reshape((b,) + out.shape[2:])
 

@@ -103,11 +103,16 @@ def test_bench_state_checker(tmp_path):
 
 
 def test_bench_state_expected_matches_bench_legs():
-    """expected_legs() (the checker's live bench.py parse) must agree
-    with the EXPECTED fallback — drift would let the watcher declare
-    victory without a newly-added leg when bench.py is unreadable."""
+    """Three-way pin: an INDEPENDENT parse of bench.py's run() calls must
+    be non-empty (else the checker's regex broke and expected_legs() is
+    silently running on the frozen fallback), must match the EXPECTED
+    fallback (leg-list drift), and must be what expected_legs() returns."""
+    import re
+
     from scripts.bench_state import EXPECTED, expected_legs
 
-    legs = expected_legs()
-    assert legs != EXPECTED or len(legs) >= 15  # parse actually ran
-    assert sorted(legs) == sorted(EXPECTED)
+    src = open(os.path.join(REPO, "bench.py")).read()
+    legs_direct = re.findall(r'^\s*run\("([a-z0-9_]+)"', src, re.M)
+    assert legs_direct, "leg regex no longer matches bench.py"
+    assert sorted(legs_direct) == sorted(EXPECTED)
+    assert expected_legs() == legs_direct

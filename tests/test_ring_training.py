@@ -71,6 +71,31 @@ class TestRingTrainStep:
             np.asarray(p_p["blocks"]["Wq"]), np.asarray(p_s["blocks"]["Wq"]),
             atol=1e-5)
 
+    def test_sp_moe_train_matches_serial_curve(self):
+        """SP x MoE (round-4: the former 'dense FFN only' rejection):
+        ring_forward(return_aux=True) threads the load-balance aux loss
+        through, so the SP step optimizes the identical objective —
+        curves and end-state expert weights must match serial."""
+        cfg = _cfg(moe_experts=4, d_ff=32, moe_capacity_factor=4.0)
+        xs, ys = _batches(cfg)
+        params = init_params(cfg)
+
+        serial = make_train_step(cfg)
+        p_s, curve_s = _run_curve(serial, params, init_opt_state(params),
+                                  xs, ys)
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+        sp = make_ring_train_step(cfg, mesh)
+        p_p, curve_p = _run_curve(sp, params, init_opt_state(params), xs, ys)
+        np.testing.assert_allclose(curve_p, curve_s, rtol=1e-4,
+                                   err_msg="SP MoE curve != serial")
+        np.testing.assert_allclose(
+            np.asarray(p_p["blocks"]["W1"]), np.asarray(p_s["blocks"]["W1"]),
+            atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(p_p["blocks"]["Wg"]), np.asarray(p_s["blocks"]["Wg"]),
+            atol=1e-5)
+
     def test_dpxsp_train_matches_serial_curve(self):
         cfg = _cfg()
         xs, ys = _batches(cfg)
@@ -85,22 +110,15 @@ class TestRingTrainStep:
         _, curve_p = _run_curve(sp, params, init_opt_state(params), xs, ys)
         np.testing.assert_allclose(curve_p, curve_s, rtol=1e-4)
 
-    def test_moe_rejected(self):
-        cfg = _cfg(moe_experts=4, d_ff=32)
-        mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
-        with pytest.raises(NotImplementedError):
-            make_ring_train_step(cfg, mesh)
-
     def test_multi_step_factory_validates_too(self):
         """Guards live in the shared builder: the multi-step factory must
-        reject the same configs as the single-step one."""
+        reject the same configs as the single-step one. (MoE is no longer
+        rejected — test_sp_moe_train_matches_serial_curve covers it.)"""
         from deeplearning4j_tpu.models.transformer import (
             make_ring_train_multi_step,
         )
 
         mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
-        with pytest.raises(NotImplementedError):
-            make_ring_train_multi_step(_cfg(moe_experts=4, d_ff=32), mesh)
         with pytest.raises(ValueError):
             make_ring_train_multi_step(_cfg(accum_steps=2), mesh)
 
