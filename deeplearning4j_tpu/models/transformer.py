@@ -67,10 +67,10 @@ class TransformerConfig:
     warmup_steps: int = 0
     lr_schedule: str = "none"     # "none" | "cosine"
     total_steps: int = 0
-    # gradient accumulation: microbatches per optimizer step (exact
-    # full-batch equivalence at 1/A activation memory; dense FFN only —
-    # MoE capacity/aux statistics are batch-dependent, so make_train_step
-    # rejects the combination)
+    # gradient accumulation: microbatches per optimizer step at 1/A the
+    # activation memory. Dense: exact full-batch equivalence
+    # (mean-of-means). MoE: the GROUPED objective (group = microbatch,
+    # GShard/Switch semantics) — identical to PP with n_micro=A.
     accum_steps: int = 1
     seed: int = 0
     # flash-attention pallas kernel (ops/pallas_attention.py) on the
@@ -486,11 +486,12 @@ def _build_step(cfg: TransformerConfig):
     """The pure (unjitted) optimizer step shared by make_train_step and
     the fused multi-step path; validates cfg combinations loudly."""
     accum_steps = cfg.accum_steps
-    if accum_steps > 1 and cfg.moe_experts:
-        raise ValueError(
-            "gradient accumulation with MoE is not full-batch equivalent "
-            "(per-microbatch expert capacity + aux-loss statistics); use "
-            "accum_steps=1 or a dense FFN config")
+    # accum_steps > 1 with MoE = the GROUPED objective (group = one
+    # microbatch): per-group expert capacity + aux statistics, the same
+    # GShard/Switch semantics as the pipelined path — accum A=k and
+    # PP n_micro=k optimize the IDENTICAL loss on identical groups
+    # (test_accum_moe_equals_pipelined_groups). Dense configs remain
+    # exactly full-batch equivalent (mean-of-means).
     _validate_schedule(cfg)
 
     def step(params, opt, tokens, targets):
@@ -548,9 +549,10 @@ def make_train_step(cfg: TransformerConfig, mesh: Optional[Mesh] = None):
     microbatches whose gradients are averaged in a lax.scan before ONE
     optimizer update — for dense configs numerically the full-batch step
     (the loss is a batch mean, so mean-of-microbatch-grads == full-batch
-    grad) at 1/A the activation memory. MoE configs are rejected: expert
-    capacity and the load-balance aux loss are batch-statistic dependent,
-    so microbatching would silently change the objective."""
+    grad) at 1/A the activation memory. MoE configs train the GROUPED
+    objective (expert capacity + aux statistics per microbatch group —
+    GShard/Switch semantics, identical to the pipelined path at
+    n_micro=A; test_accum_moe_equals_pipelined_groups)."""
     step = _build_step(cfg)
     if mesh is None:
         return jax.jit(step, **_donation_kwargs())

@@ -115,4 +115,8 @@ def test_bench_state_expected_matches_bench_legs():
     legs_direct = re.findall(r'^\s*run\("([a-z0-9_]+)"', src, re.M)
     assert legs_direct, "leg regex no longer matches bench.py"
     assert sorted(legs_direct) == sorted(EXPECTED)
-    assert expected_legs() == legs_direct
+    legs = expected_legs()
+    # identity check: the fallback path returns the EXPECTED list OBJECT
+    # itself, so a broken checker regex can't hide behind equal contents
+    assert legs is not EXPECTED, "expected_legs() fell back to EXPECTED"
+    assert legs == legs_direct

@@ -352,12 +352,39 @@ class TestAccumAndSchedule:
             last = float(lm.fit(x, y))
         assert np.isfinite(last) and last < first
 
-    def test_moe_accum_rejected(self):
-        import pytest
+    def test_accum_moe_equals_pipelined_groups(self):
+        """Gradient accumulation x MoE (round-4: the former rejection)
+        optimizes the GROUPED objective — with the same contiguous-group
+        split, accum A=2 and PP n_micro=2 must compute the SAME loss on
+        the same batch (cross-validation of the two microbatched MoE
+        paths against each other)."""
+        import jax as _jax
+        from jax.sharding import Mesh
 
-        cfg = _cfg(accum_steps=2, moe_experts=4, d_ff=32)
-        with pytest.raises(ValueError):
-            TransformerLM(cfg)
+        from deeplearning4j_tpu.models.transformer import (
+            init_opt_state,
+            init_params,
+            make_pipeline_train_step,
+            make_train_step,
+            shard_params_pipeline,
+        )
+
+        cfg_a = _cfg(accum_steps=2, moe_experts=4, d_ff=32, max_len=16)
+        cfg_p = _cfg(accum_steps=1, moe_experts=4, d_ff=32, max_len=16)
+        params = init_params(cfg_a)
+        rng = np.random.default_rng(4)
+        toks = rng.integers(0, cfg_a.vocab_size, (4, cfg_a.max_len + 1))
+        x = jnp.asarray(toks[:, :-1], jnp.int32)
+        y = jnp.asarray(toks[:, 1:], jnp.int32)
+
+        _, _, loss_a = make_train_step(cfg_a)(
+            params, init_opt_state(params), x, y)
+
+        mesh = Mesh(np.array(_jax.devices()[:2]), ("pipe",))
+        pp = shard_params_pipeline(params, cfg_p, mesh)
+        _, _, loss_p = make_pipeline_train_step(cfg_p, mesh, n_micro=2)(
+            pp, init_opt_state(pp), x, y)
+        np.testing.assert_allclose(float(loss_a), float(loss_p), rtol=1e-5)
 
 
 class TestKVCacheDecoding:
