@@ -1365,7 +1365,10 @@ class TransformerLM:
         default for mesh-sharded models and for MoE (where capacity-bound
         routing is batch-vs-stream dependent: KV decode routes each step
         as its own no-drop group, which matches the batch forward only in
-        the drop-free regime — pass use_cache=True to opt in)."""
+        the drop-free regime — pass use_cache=True to opt in). Tensor-
+        parallel ('model') meshes support use_cache=True: GSPMD shards
+        prefill+decode on the head dim (equivalence-locked by
+        test_tp_mesh_kv_decode_equals_serial)."""
         cfg = self._run_cfg
         if n_new >= cfg.max_len:
             raise ValueError(f"n_new {n_new} must be < max_len {cfg.max_len}")

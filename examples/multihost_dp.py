@@ -13,7 +13,9 @@ collectives) — the exact same script a TPU pod runs per host, where the
 provisioner (provision/tpu_pod.py) injects the same env contract. Run:
 
     python examples/multihost_dp.py            # parent: spawns 2 workers
-    DL4J_TPU_COORDINATOR=... python examples/multihost_dp.py   # one worker
+    # or launch each worker yourself (the full contract, one process each):
+    DL4J_TPU_COORDINATOR=host:port DL4J_TPU_NUM_PROCESSES=2 \
+        DL4J_TPU_PROCESS_ID=<0|1> python examples/multihost_dp.py
 
 Each worker trains the same MLP data-parallel over the global mesh and
 verifies its parameters track a serial run to float32 tolerance (the
