@@ -47,15 +47,30 @@ def _try_build() -> None:
         logger.debug("native build skipped: %s", e)
 
 
+def _source_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "native", "src", "dl4j_tpu_native.cpp")
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    src = _source_path()
+    stale = (os.path.exists(_LIB_PATH) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH))
+    if not os.path.exists(_LIB_PATH) or stale:
+        # a stale .so (older than the source) would silently miss newer
+        # symbols — rebuild rather than half-load
         _try_build()
     if not os.path.exists(_LIB_PATH):
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError as e:  # half-written/foreign .so must not kill import
+        logger.debug("native load failed: %s", e)
+        return None
     lib.dl4j_read_idx.restype = ctypes.c_int
     lib.dl4j_read_idx.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
@@ -83,6 +98,35 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_float),
     ]
     lib.dl4j_prefetch_stop.argtypes = [ctypes.c_void_p]
+    try:
+        # npz reader/prefetcher (round 4) — absent from a pre-round-4 .so
+        lib.dl4j_npz_open.restype = ctypes.c_void_p
+        lib.dl4j_npz_open.argtypes = [ctypes.c_char_p]
+        lib.dl4j_npz_count.restype = ctypes.c_int
+        lib.dl4j_npz_count.argtypes = [ctypes.c_void_p]
+        lib.dl4j_npz_member_info.restype = ctypes.c_int
+        lib.dl4j_npz_member_info.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dl4j_npz_member_data.restype = ctypes.c_int
+        lib.dl4j_npz_member_data.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+        ]
+        lib.dl4j_npz_close.argtypes = [ctypes.c_void_p]
+        lib.dl4j_npz_prefetch_open.restype = ctypes.c_void_p
+        lib.dl4j_npz_prefetch_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ]
+        lib.dl4j_npz_prefetch_next.restype = ctypes.c_int
+        lib.dl4j_npz_prefetch_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.dl4j_npz_prefetch_close.argtypes = [ctypes.c_void_p]
+        lib._has_npz = True
+    except AttributeError:
+        lib._has_npz = False
     _lib = lib
     return lib
 
@@ -246,6 +290,98 @@ class NativePrefetchIterator:
             for b in range(0, len(self.features) - self.batch + 1, self.batch):
                 sel = idx[b : b + self.batch]
                 yield self.features[sel], self.labels[sel]
+
+
+# ---------------------------------------------------------------------------
+# npz exported-dataset reading (training_master export/fit(path) plane)
+# ---------------------------------------------------------------------------
+
+_NPZ_DTYPES = {0: np.dtype("<f4"), 1: np.dtype("<f8"), 2: np.dtype("<i4"),
+               3: np.dtype("<i8"), 4: np.dtype(np.bool_)}
+
+
+def _npz_handle_to_dict(lib, handle) -> Optional[dict]:
+    """Copy every member of an open native npz handle into numpy arrays.
+    Returns None if any member can't be decoded (caller falls back)."""
+    n = lib.dl4j_npz_count(handle)
+    if n < 0:
+        return None
+    out = {}
+    for i in range(n):
+        name = ctypes.create_string_buffer(512)
+        dt = ctypes.c_int()
+        nd = ctypes.c_int()
+        dims = (ctypes.c_int64 * 8)()
+        if lib.dl4j_npz_member_info(handle, i, name, 512, ctypes.byref(dt),
+                                    ctypes.byref(nd), dims) != 0:
+            return None
+        shape = tuple(dims[j] for j in range(nd.value))
+        arr = np.empty(shape, _NPZ_DTYPES[dt.value])
+        if lib.dl4j_npz_member_data(
+                handle, i, arr.ctypes.data_as(ctypes.c_void_p)) != 0:
+            return None
+        out[name.value.decode()] = arr
+    return out
+
+
+def read_npz(path: str) -> dict:
+    """Parse a numpy .npz (stored entries) into {name: array} — the
+    exported-dataset minibatch format (training_master.export_datasets;
+    the reference's DataSet.save files consumed by fit(String path),
+    SparkDl4jMultiLayer.java:217). Native parse off the GIL when the
+    library is available; np.load otherwise (also the fallback for
+    compressed/ZIP64/exotic-dtype files the native parser declines)."""
+    lib = _load()
+    if lib is not None and lib._has_npz:
+        handle = lib.dl4j_npz_open(path.encode())
+        if handle:
+            try:
+                out = _npz_handle_to_dict(lib, handle)
+            finally:
+                lib.dl4j_npz_close(handle)
+            if out is not None:
+                return out
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def iter_npz(paths, capacity: int = 4) -> Iterator[dict]:
+    """Stream {name: array} dicts for `paths` IN ORDER, with a native
+    background thread parsing ahead (the AsyncDataSetIterator ring-buffer
+    role applied to the exported-dataset feed). Falls back to sequential
+    read_npz when the native library is unavailable; any single file the
+    native parser declines is re-read via np.load without breaking the
+    stream."""
+    paths = list(paths)
+    lib = _load()
+    if lib is None or not lib._has_npz or not paths:
+        for p in paths:
+            yield read_npz(p)
+        return
+    arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+    handle = lib.dl4j_npz_prefetch_open(arr, len(paths), capacity)
+    if not handle:
+        for p in paths:
+            yield read_npz(p)
+        return
+    try:
+        while True:
+            nh = ctypes.c_void_p()
+            idx = lib.dl4j_npz_prefetch_next(handle, ctypes.byref(nh))
+            if idx < 0:
+                break
+            out = None
+            if nh.value:
+                try:
+                    out = _npz_handle_to_dict(lib, nh)
+                finally:
+                    lib.dl4j_npz_close(nh)
+            if out is None:  # native declined this file — np.load it
+                with np.load(paths[idx]) as z:
+                    out = {k: z[k] for k in z.files}
+            yield out
+    finally:
+        lib.dl4j_npz_prefetch_close(handle)
 
 
 NATIVE_AVAILABLE = native_available()

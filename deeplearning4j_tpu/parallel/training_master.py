@@ -171,13 +171,17 @@ def load_exported_datasets(path,
         files = sorted(glob.glob(os.path.join(path, f"{prefix}*.npz")))
     if not files:
         raise ValueError(f"no exported datasets under {path!r}")
-    for f in files:
-        with np.load(f) as z:
-            yield DataSet(
-                z["features"], z["labels"],
-                z["features_mask"] if "features_mask" in z else None,
-                z["labels_mask"] if "labels_mask" in z else None,
-            )
+    # native ordered prefetch: a background C thread parses file i+1..i+k
+    # while the device trains on file i (AsyncDataSetIterator ring buffer
+    # applied to the exported feed; np.load fallback inside iter_npz)
+    from deeplearning4j_tpu.native import iter_npz
+
+    for z in iter_npz(files):
+        yield DataSet(
+            z["features"], z["labels"],
+            z.get("features_mask"),
+            z.get("labels_mask"),
+        )
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):

@@ -323,6 +323,296 @@ int dl4j_prefetch_next(void* handle, float* feat_out, float* label_out) {
   return ret;
 }
 
+// ---------------------------------------------------------------------------
+// npz (numpy zip) reader + ordered background prefetcher — the native feed
+// path for the exported-dataset plane (training_master.export_datasets
+// writes one STORED-entry npz per minibatch, the reference's
+// RDDTrainingApproach.Export split files; fit(path) then streams them:
+// ParameterAveragingTrainingMaster.java:148-168, SparkDl4jMultiLayer:217).
+// Parsing + file IO happen on a worker thread, off the GIL.
+// Scope: stored (uncompressed) entries, little-endian f4/f8/i4/i8/b1,
+// C-order, no ZIP64 — anything else returns null and Python falls back to
+// np.load.
+// ---------------------------------------------------------------------------
+
+struct NpzMember {
+  std::string name;       // member name without the ".npy" suffix
+  int dtype;              // 0=f4 1=f8 2=i4 3=i8 4=b1
+  int ndim;
+  int64_t dims[8];
+  int64_t count;          // product of dims
+  size_t esize;
+  void* data;             // malloc'd, owned by NpzFile
+};
+
+struct NpzFile {
+  std::vector<NpzMember> members;
+  ~NpzFile() {
+    for (auto& m : members) free(m.data);
+  }
+};
+
+static uint32_t rd_u32(const unsigned char* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+static uint16_t rd_u16(const unsigned char* p) {
+  return (uint16_t)p[0] | ((uint16_t)p[1] << 8);
+}
+
+// Parses one stored .npy payload (buf/len) into m (fills dtype/dims/data).
+// Returns false on any unsupported feature.
+static bool parse_npy(const unsigned char* buf, size_t len, NpzMember* m) {
+  if (len < 10 || memcmp(buf, "\x93NUMPY", 6) != 0) return false;
+  int major = buf[6];
+  size_t hlen, hoff;
+  if (major == 1) {
+    hlen = rd_u16(buf + 8);
+    hoff = 10;
+  } else if (major == 2 || major == 3) {
+    if (len < 12) return false;
+    hlen = rd_u32(buf + 8);
+    hoff = 12;
+  } else {
+    return false;
+  }
+  if (hoff + hlen > len) return false;
+  std::string h((const char*)buf + hoff, hlen);
+  // descr
+  size_t dp = h.find("'descr'");
+  if (dp == std::string::npos) return false;
+  size_t q1 = h.find('\'', dp + 7);
+  size_t q2 = (q1 == std::string::npos) ? q1 : h.find('\'', q1 + 1);
+  if (q2 == std::string::npos) return false;
+  std::string descr = h.substr(q1 + 1, q2 - q1 - 1);
+  static const struct { const char* d; int code; size_t es; } kTypes[] = {
+      {"<f4", 0, 4}, {"<f8", 1, 8}, {"<i4", 2, 4}, {"<i8", 3, 8},
+      {"|b1", 4, 1},
+  };
+  m->dtype = -1;
+  for (auto& t : kTypes) {
+    if (descr == t.d) { m->dtype = t.code; m->esize = t.es; }
+  }
+  if (m->dtype < 0) return false;
+  // fortran_order must be False (C-order)
+  size_t fo = h.find("'fortran_order'");
+  if (fo == std::string::npos || h.find("False", fo) == std::string::npos ||
+      h.find("False", fo) > fo + 24) {
+    return false;
+  }
+  // shape tuple
+  size_t sp = h.find("'shape'");
+  if (sp == std::string::npos) return false;
+  size_t p1 = h.find('(', sp);
+  size_t p2 = (p1 == std::string::npos) ? p1 : h.find(')', p1);
+  if (p2 == std::string::npos) return false;
+  m->ndim = 0;
+  m->count = 1;
+  size_t pos = p1 + 1;
+  while (pos < p2) {
+    while (pos < p2 && (h[pos] == ' ' || h[pos] == ',')) pos++;
+    if (pos >= p2) break;
+    if (m->ndim >= 8) return false;
+    int64_t v = 0;
+    bool any = false;
+    while (pos < p2 && h[pos] >= '0' && h[pos] <= '9') {
+      v = v * 10 + (h[pos] - '0');
+      pos++;
+      any = true;
+    }
+    if (!any) return false;
+    m->dims[m->ndim++] = v;
+    m->count *= v;
+  }
+  // scalar () => ndim 0, count 1
+  size_t need = (size_t)m->count * m->esize;
+  if (hoff + hlen + need > len) return false;
+  m->data = malloc(need ? need : 1);
+  if (!m->data) return false;
+  memcpy(m->data, buf + hoff + hlen, need);
+  return true;
+}
+
+static void* npz_open_impl(const char* path);
+
+// Exception wall: a corrupt file (garbage sizes -> bad_alloc, etc.) must
+// DECLINE (null -> Python np.load fallback), never unwind across the C
+// ABI into ctypes or terminate the prefetch worker.
+void* dl4j_npz_open(const char* path) {
+  try {
+    return npz_open_impl(path);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+static void* npz_open_impl(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  if (fseek(f, 0, SEEK_END) != 0) { fclose(f); return nullptr; }
+  long fsize = ftell(f);
+  if (fsize < 22) { fclose(f); return nullptr; }
+  // find EOCD (sig 0x06054b50) in the last 64K+22
+  long tail = fsize < 65558 ? fsize : 65558;
+  std::vector<unsigned char> tb((size_t)tail);
+  fseek(f, fsize - tail, SEEK_SET);
+  if (fread(tb.data(), 1, (size_t)tail, f) != (size_t)tail) {
+    fclose(f);
+    return nullptr;
+  }
+  long eocd = -1;
+  for (long i = tail - 22; i >= 0; i--) {
+    if (tb[i] == 0x50 && tb[i + 1] == 0x4b && tb[i + 2] == 0x05 &&
+        tb[i + 3] == 0x06) {
+      eocd = i;
+      break;
+    }
+  }
+  if (eocd < 0) { fclose(f); return nullptr; }
+  uint16_t n_entries = rd_u16(&tb[eocd + 10]);
+  uint32_t cd_off = rd_u32(&tb[eocd + 16]);
+  if (n_entries == 0xFFFF || cd_off == 0xFFFFFFFFu) {  // ZIP64
+    fclose(f);
+    return nullptr;
+  }
+  NpzFile* nf = new NpzFile();
+  long pos = (long)cd_off;
+  for (int e = 0; e < n_entries; e++) {
+    unsigned char ch[46];
+    fseek(f, pos, SEEK_SET);
+    if (fread(ch, 1, 46, f) != 46 || rd_u32(ch) != 0x02014b50) goto fail;
+    {
+      uint16_t method = rd_u16(ch + 10);
+      uint32_t csize = rd_u32(ch + 20);
+      uint32_t usize = rd_u32(ch + 24);
+      uint16_t nlen = rd_u16(ch + 28);
+      uint16_t xlen = rd_u16(ch + 30);
+      uint16_t clen = rd_u16(ch + 32);
+      uint32_t lho = rd_u32(ch + 42);
+      if (method != 0 || csize != usize) goto fail;  // stored only
+      std::string name((size_t)nlen, '\0');
+      if (fread(&name[0], 1, nlen, f) != nlen) goto fail;
+      // data offset: local header's own name/extra lens (can differ)
+      unsigned char lh[30];
+      fseek(f, (long)lho, SEEK_SET);
+      if (fread(lh, 1, 30, f) != 30 || rd_u32(lh) != 0x04034b50) goto fail;
+      long doff = (long)lho + 30 + rd_u16(lh + 26) + rd_u16(lh + 28);
+      std::vector<unsigned char> payload((size_t)usize);
+      fseek(f, doff, SEEK_SET);
+      if (usize && fread(payload.data(), 1, usize, f) != usize) goto fail;
+      NpzMember m;
+      m.data = nullptr;
+      if (name.size() > 4 && name.compare(name.size() - 4, 4, ".npy") == 0) {
+        name.resize(name.size() - 4);
+      }
+      m.name = name;
+      if (!parse_npy(payload.data(), payload.size(), &m)) goto fail;
+      nf->members.push_back(std::move(m));
+      pos += 46 + nlen + xlen + clen;
+    }
+  }
+  fclose(f);
+  return nf;
+fail:
+  fclose(f);
+  delete nf;
+  return nullptr;
+}
+
+int dl4j_npz_count(void* h) {
+  return h ? (int)((NpzFile*)h)->members.size() : -1;
+}
+
+int dl4j_npz_member_info(void* h, int i, char* name_buf, int name_cap,
+                         int* dtype, int* ndim, int64_t* dims) {
+  NpzFile* nf = (NpzFile*)h;
+  if (!nf || i < 0 || (size_t)i >= nf->members.size()) return -1;
+  const NpzMember& m = nf->members[(size_t)i];
+  if ((int)m.name.size() + 1 > name_cap) return -2;
+  memcpy(name_buf, m.name.c_str(), m.name.size() + 1);
+  *dtype = m.dtype;
+  *ndim = m.ndim;
+  for (int d = 0; d < m.ndim; d++) dims[d] = m.dims[d];
+  return 0;
+}
+
+int dl4j_npz_member_data(void* h, int i, void* out) {
+  NpzFile* nf = (NpzFile*)h;
+  if (!nf || i < 0 || (size_t)i >= nf->members.size()) return -1;
+  const NpzMember& m = nf->members[(size_t)i];
+  memcpy(out, m.data, (size_t)m.count * m.esize);
+  return 0;
+}
+
+void dl4j_npz_close(void* h) { delete (NpzFile*)h; }
+
+// Ordered background prefetcher over a list of npz paths: the worker
+// parses files ahead (bounded queue); the consumer pops them IN ORDER.
+// A file that fails to parse yields a null handle (consumer falls back).
+struct NpzPrefetcher {
+  std::vector<std::string> paths;
+  size_t capacity;
+  std::deque<NpzFile*> queue;   // parallel to next_idx ordering
+  size_t produced = 0, consumed = 0;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+
+  void run() {
+    for (size_t i = 0; i < paths.size() && !stop; i++) {
+      NpzFile* nf = (NpzFile*)dl4j_npz_open(paths[i].c_str());
+      std::unique_lock<std::mutex> lk(mu);
+      cv_put.wait(lk, [&] { return queue.size() < capacity || stop; });
+      if (stop) { delete nf; return; }
+      queue.push_back(nf);
+      produced++;
+      cv_get.notify_one();
+    }
+  }
+};
+
+void* dl4j_npz_prefetch_open(const char* const* paths, int n_paths,
+                             int capacity) {
+  if (n_paths <= 0) return nullptr;
+  NpzPrefetcher* p = new NpzPrefetcher();
+  for (int i = 0; i < n_paths; i++) p->paths.emplace_back(paths[i]);
+  p->capacity = (size_t)(capacity > 0 ? capacity : 4);
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Returns the file index whose handle is placed in *out (may be null on
+// parse failure — caller falls back for that file), or -1 when the
+// stream is exhausted. The handle is owned by the caller: free it with
+// dl4j_npz_close.
+int dl4j_npz_prefetch_next(void* h, void** out) {
+  NpzPrefetcher* p = (NpzPrefetcher*)h;
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->consumed >= p->paths.size()) return -1;
+  p->cv_get.wait(lk, [&] { return !p->queue.empty() || p->stop; });
+  if (p->queue.empty()) return -1;  // stopped mid-stream
+  *out = p->queue.front();
+  p->queue.pop_front();
+  int idx = (int)p->consumed++;
+  lk.unlock();
+  p->cv_put.notify_one();
+  return idx;
+}
+
+void dl4j_npz_prefetch_close(void* h) {
+  NpzPrefetcher* p = (NpzPrefetcher*)h;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+    p->cv_put.notify_all();
+    p->cv_get.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  for (NpzFile* nf : p->queue) delete nf;
+  delete p;
+}
+
 void dl4j_prefetch_stop(void* handle) {
   Prefetcher* p = (Prefetcher*)handle;
   {
