@@ -324,6 +324,11 @@ def _npz_handle_to_dict(lib, handle) -> Optional[dict]:
     return out
 
 
+def _npload_dict(path: str) -> dict:
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
 def read_npz(path: str) -> dict:
     """Parse a numpy .npz (stored entries) into {name: array} — the
     exported-dataset minibatch format (training_master.export_datasets;
@@ -331,6 +336,7 @@ def read_npz(path: str) -> dict:
     SparkDl4jMultiLayer.java:217). Native parse off the GIL when the
     library is available; np.load otherwise (also the fallback for
     compressed/ZIP64/exotic-dtype files the native parser declines)."""
+    path = os.fspath(path)  # pathlib.Path accepted, like np.load
     lib = _load()
     if lib is not None and lib._has_npz:
         handle = lib.dl4j_npz_open(path.encode())
@@ -341,8 +347,7 @@ def read_npz(path: str) -> dict:
                 lib.dl4j_npz_close(handle)
             if out is not None:
                 return out
-    with np.load(path) as z:
-        return {k: z[k] for k in z.files}
+    return _npload_dict(path)
 
 
 def iter_npz(paths, capacity: int = 4) -> Iterator[dict]:
@@ -352,7 +357,7 @@ def iter_npz(paths, capacity: int = 4) -> Iterator[dict]:
     read_npz when the native library is unavailable; any single file the
     native parser declines is re-read via np.load without breaking the
     stream."""
-    paths = list(paths)
+    paths = [os.fspath(p) for p in paths]  # pathlib.Path accepted
     lib = _load()
     if lib is None or not lib._has_npz or not paths:
         for p in paths:
@@ -377,8 +382,7 @@ def iter_npz(paths, capacity: int = 4) -> Iterator[dict]:
                 finally:
                     lib.dl4j_npz_close(nh)
             if out is None:  # native declined this file — np.load it
-                with np.load(paths[idx]) as z:
-                    out = {k: z[k] for k in z.files}
+                out = _npload_dict(paths[idx])
             yield out
     finally:
         lib.dl4j_npz_prefetch_close(handle)

@@ -408,6 +408,9 @@ static bool parse_npy(const unsigned char* buf, size_t len, NpzMember* m) {
   if (p2 == std::string::npos) return false;
   m->ndim = 0;
   m->count = 1;
+  // overflow guards: a crafted shape must DECLINE, not wrap int64 (UB)
+  // and sneak a tiny `need` past the bounds check below
+  const int64_t kMaxCount = (int64_t)1 << 40;  // far above any minibatch
   size_t pos = p1 + 1;
   while (pos < p2) {
     while (pos < p2 && (h[pos] == ' ' || h[pos] == ',')) pos++;
@@ -416,15 +419,19 @@ static bool parse_npy(const unsigned char* buf, size_t len, NpzMember* m) {
     int64_t v = 0;
     bool any = false;
     while (pos < p2 && h[pos] >= '0' && h[pos] <= '9') {
+      if (v > kMaxCount) return false;  // before the *10 can overflow
       v = v * 10 + (h[pos] - '0');
       pos++;
       any = true;
     }
-    if (!any) return false;
+    if (!any || v > kMaxCount) return false;
     m->dims[m->ndim++] = v;
+    if (v != 0 && m->count > kMaxCount / (v ? v : 1)) return false;
     m->count *= v;
   }
-  // scalar () => ndim 0, count 1
+  // scalar () => ndim 0, count 1; the payload must actually contain the
+  // claimed elements (count bounded above, so this product can't wrap)
+  if (m->count > (int64_t)(len / m->esize) + 1) return false;
   size_t need = (size_t)m->count * m->esize;
   if (hoff + hlen + need > len) return false;
   m->data = malloc(need ? need : 1);
@@ -553,7 +560,7 @@ struct NpzPrefetcher {
   std::vector<std::string> paths;
   size_t capacity;
   std::deque<NpzFile*> queue;   // parallel to next_idx ordering
-  size_t produced = 0, consumed = 0;
+  size_t consumed = 0;
   std::mutex mu;
   std::condition_variable cv_put, cv_get;
   std::thread worker;
@@ -566,7 +573,6 @@ struct NpzPrefetcher {
       cv_put.wait(lk, [&] { return queue.size() < capacity || stop; });
       if (stop) { delete nf; return; }
       queue.push_back(nf);
-      produced++;
       cv_get.notify_one();
     }
   }

@@ -24,7 +24,7 @@ EXPECTED = [
     "word2vec_sgns", "transformer_lm", "resnet50", "resnet50_bf16",
     "transformer_lm_big", "flash_attention", "ring_attention",
     "lstm_kernel", "north_star", "reference_cpu_lenet5_torch",
-    "scaling_virtual8",
+    "native_feed", "scaling_virtual8",
 ]
 
 _BENCH_PY = os.path.join(os.path.dirname(os.path.dirname(
