@@ -1,0 +1,239 @@
+"""BERT-style bidirectional encoder with masked-LM pretraining.
+
+Beyond-reference model family (the reference era, dl4j 0.4, predates
+BERT), built on the same whole-step-jit machinery as the flagship LM:
+the per-layer block body mirrors models/transformer.py's pre-LN design
+but attends BIDIRECTIONALLY with a key-padding mask (the reference's
+closest relatives are its masked time-series paths —
+MultiLayerNetwork.setLayerMaskArrays :2332 — and the word2vec CBOW
+context objective, SURVEY.md section 2.3; the MLM objective is CBOW's
+"predict the held-out token from both sides" idea at transformer scale).
+
+Masking follows the standard 80/10/10 recipe: of the positions selected
+for prediction, 80% become [MASK], 10% a random token, 10% keep the
+original. Loss is cross-entropy over the SELECTED positions only
+(weights argument), with the tied embedding head.
+
+Everything (forward + masked loss + Adam) traces into ONE XLA program
+per batch shape; `fit` and `masked_accuracy` are the user surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.models.transformer import (
+    Params,
+    _adam_update,
+    _ln,
+    _scheduled_lr,
+    _validate_schedule,
+    init_opt_state,
+)
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 1000
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_len: int = 64
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    clip_grad_norm: float = 0.0
+    warmup_steps: int = 0
+    lr_schedule: str = "none"
+    total_steps: int = 0
+    mlm_prob: float = 0.15
+    pad_token_id: int = 0
+    mask_token_id: Optional[int] = None  # default: vocab_size - 1
+    seed: int = 0
+
+    @property
+    def mask_id(self) -> int:
+        return (self.mask_token_id if self.mask_token_id is not None
+                else self.vocab_size - 1)
+
+
+def init_params(cfg: BertConfig) -> Params:
+    """Same init family as the flagship (scaled-normal embeddings, zeros
+    biases, ones LN gains); block leaves stacked [L, ...] for lax.scan."""
+    k = jax.random.PRNGKey(cfg.seed)
+    ks = jax.random.split(k, 8)
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    s = 0.02
+
+    def nrm(key, shape, scale=s):
+        return (jax.random.normal(key, shape, jnp.float32) * scale)
+
+    return {
+        "embed": nrm(ks[0], (cfg.vocab_size, d)),
+        "pos": nrm(ks[1], (cfg.max_len, d)),
+        "blocks": {
+            "ln1_g": jnp.ones((L, d), jnp.float32), "ln1_b": jnp.zeros((L, d), jnp.float32),
+            "Wq": nrm(ks[2], (L, d, d)), "Wk": nrm(ks[3], (L, d, d)),
+            "Wv": nrm(ks[4], (L, d, d)), "Wo": nrm(ks[5], (L, d, d)),
+            "ln2_g": jnp.ones((L, d), jnp.float32), "ln2_b": jnp.zeros((L, d), jnp.float32),
+            "W1": nrm(ks[6], (L, d, f)), "b1": jnp.zeros((L, f), jnp.float32),
+            "W2": nrm(ks[7], (L, f, d)), "b2": jnp.zeros((L, d), jnp.float32),
+        },
+        "lnf_g": jnp.ones((d,), jnp.float32), "lnf_b": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def _bi_attention(q, k, v, n_heads: int, key_mask) -> jax.Array:
+    """Full bidirectional attention with an optional key-padding mask
+    (key_mask [N, T] bool; False keys are invisible to every query) —
+    the encoder twin of transformer._attention's causal path."""
+    n, t, d = q.shape
+    hd = d // n_heads
+    qh = q.reshape(n, t, n_heads, hd)
+    kh = k.reshape(n, t, n_heads, hd)
+    vh = v.reshape(n, t, n_heads, hd)
+    s = jnp.einsum("nqhd,nkhd->nhqk", qh, kh) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :], s,
+                      jnp.asarray(-1e9, s.dtype))
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("nhqk,nkhd->nqhd", p, vh).reshape(n, t, d)
+
+
+def encode(params: Params, tokens: jax.Array, cfg: BertConfig,
+           key_mask=None) -> jax.Array:
+    """tokens [N, T] -> hidden states [N, T, d] (post final-LN). key_mask
+    defaults to tokens != pad_token_id."""
+    n, t = tokens.shape
+    if key_mask is None:
+        key_mask = tokens != cfg.pad_token_id
+    h = params["embed"][tokens] + params["pos"][:t][None]
+
+    def block(h, bp):
+        x = _ln(h, bp["ln1_g"], bp["ln1_b"])
+        att = _bi_attention(x @ bp["Wq"], x @ bp["Wk"], x @ bp["Wv"],
+                            cfg.n_heads, key_mask)
+        h = h + att @ bp["Wo"]
+        x = _ln(h, bp["ln2_g"], bp["ln2_b"])
+        return h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] \
+            + bp["b2"], None
+
+    h, _ = lax.scan(block, h, params["blocks"])
+    return _ln(h, params["lnf_g"], params["lnf_b"])
+
+
+def mlm_logits(params: Params, tokens: jax.Array, cfg: BertConfig,
+               key_mask=None) -> jax.Array:
+    return encode(params, tokens, cfg, key_mask) @ params["embed"].T
+
+
+def mlm_loss(params: Params, tokens: jax.Array, targets: jax.Array,
+             weights: jax.Array, cfg: BertConfig) -> jax.Array:
+    """Cross-entropy over the selected (weight > 0) positions only."""
+    logits = mlm_logits(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = weights.astype(jnp.float32)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def mask_tokens(tokens: np.ndarray, cfg: BertConfig,
+                rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray,
+                                                   np.ndarray]:
+    """The 80/10/10 masking recipe (host-side, like the reference's
+    host-side minibatch assembly). Returns (inputs, targets, weights):
+    inputs has the corruptions applied, targets the original ids,
+    weights 1.0 at predicted positions. Pad positions are never
+    selected."""
+    tokens = np.asarray(tokens)
+    selectable = tokens != cfg.pad_token_id
+    sel = (rng.random(tokens.shape) < cfg.mlm_prob) & selectable
+    # guarantee at least one prediction per batch (tiny batches in tests)
+    if not sel.any():
+        i = np.argwhere(selectable)
+        if len(i):
+            r, c = i[rng.integers(0, len(i))]
+            sel[r, c] = True
+    roll = rng.random(tokens.shape)
+    inputs = tokens.copy()
+    inputs[sel & (roll < 0.8)] = cfg.mask_id
+    rand_pos = sel & (roll >= 0.8) & (roll < 0.9)
+    # random replacements drawn from the vocab MINUS the pad id: a "random"
+    # pad token would become invisible as a key (key_mask is computed from
+    # the corrupted inputs) and distort every position's context
+    r = rng.integers(0, cfg.vocab_size - 1, int(rand_pos.sum()))
+    r[r >= cfg.pad_token_id] += 1
+    inputs[rand_pos] = r
+    weights = sel.astype(np.float32)
+    return inputs, tokens, weights
+
+
+def make_train_step(cfg: BertConfig):
+    """One jitted optimizer step: masked loss + Adam, the whole-step-jit
+    discipline shared with the flagship."""
+    _validate_schedule(cfg)  # same loud rejection as the flagship's step
+
+    @jax.jit
+    def step(params, opt, inputs, targets, weights):
+        loss, grads = jax.value_and_grad(mlm_loss)(
+            params, inputs, targets, weights, cfg)
+        lr = _scheduled_lr(cfg, opt["t"] + 1)
+        params, opt = _adam_update(params, grads, opt, lr,
+                                   weight_decay=cfg.weight_decay,
+                                   clip_grad_norm=cfg.clip_grad_norm)
+        return params, opt, loss
+
+    return step
+
+
+class BertMLM:
+    """User surface: masked-LM pretraining + masked-token evaluation."""
+
+    def __init__(self, cfg: BertConfig):
+        if cfg.d_model % cfg.n_heads:
+            raise ValueError("n_heads must divide d_model")
+        self.cfg = cfg
+        self.params = init_params(cfg)
+        self.opt = init_opt_state(self.params)
+        self._step = make_train_step(cfg)
+        # jitted eval surfaces too (whole-step-jit discipline: ~5ms per
+        # dispatch through the remote tunnel makes eager eval pathological)
+        self._logits = jax.jit(lambda p, t: mlm_logits(p, t, cfg))
+        self._encode = jax.jit(lambda p, t: encode(p, t, cfg))
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def fit(self, tokens) -> float:
+        """One masked-LM step on a [N, T] int batch (masking re-drawn
+        per call, as per-epoch dynamic masking)."""
+        inputs, targets, weights = mask_tokens(tokens, self.cfg, self._rng)
+        self.params, self.opt, loss = self._step(
+            self.params, self.opt, jnp.asarray(inputs, jnp.int32),
+            jnp.asarray(targets, jnp.int32), jnp.asarray(weights))
+        return float(loss)
+
+    def masked_accuracy(self, tokens, n_draws: int = 1) -> float:
+        """Fraction of masked positions predicted exactly (argmax)."""
+        hits = total = 0
+        for _ in range(n_draws):
+            inputs, targets, weights = mask_tokens(tokens, self.cfg,
+                                                   self._rng)
+            logits = self._logits(self.params,
+                                  jnp.asarray(inputs, jnp.int32))
+            pred = np.asarray(jnp.argmax(logits, axis=-1))
+            m = weights > 0
+            hits += int((pred[m] == np.asarray(targets)[m]).sum())
+            total += int(m.sum())
+        return hits / max(total, 1)
+
+    def embed_tokens(self, tokens) -> np.ndarray:
+        """Contextual embeddings [N, T, d] (the feature-extraction use)."""
+        return np.asarray(self._encode(self.params,
+                                       jnp.asarray(tokens, jnp.int32)))

@@ -1,0 +1,136 @@
+"""BERT-style masked-LM encoder (models/bert.py).
+
+Key properties: genuinely BIDIRECTIONAL attention (a future token changes
+an earlier position's logits — the opposite of the causal flagship),
+padding invisibility, the 80/10/10 masking recipe, and learnability (a
+model trained on a deterministic pattern recovers masked tokens)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.bert import (
+    BertConfig,
+    BertMLM,
+    encode,
+    init_params,
+    mask_tokens,
+    mlm_logits,
+)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=40, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                max_len=12, learning_rate=3e-3, seed=0)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+class TestEncoder:
+    def test_bidirectional_not_causal(self):
+        """Changing a LATER token must change an EARLIER position's
+        hidden state — the defining difference from the causal LM."""
+        cfg = _cfg()
+        params = init_params(cfg)
+        t1 = jnp.asarray([[5, 6, 7, 8, 9, 10]], jnp.int32)
+        t2 = t1.at[0, 5].set(11)  # perturb only the last position
+        h1 = encode(params, t1, cfg)
+        h2 = encode(params, t2, cfg)
+        dev = float(jnp.max(jnp.abs(h1[0, 0] - h2[0, 0])))
+        assert dev > 1e-6, "position 0 blind to position 5: causal, not BERT"
+
+    def test_padding_is_invisible(self):
+        """Extending a sequence with pad tokens must not change the real
+        positions' hidden states (key-padding mask)."""
+        cfg = _cfg()
+        params = init_params(cfg)
+        short = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+        padded = jnp.asarray([[5, 6, 7, 8, 0, 0, 0]], jnp.int32)
+        h_s = encode(params, short, cfg)
+        h_p = encode(params, padded, cfg)
+        np.testing.assert_allclose(np.asarray(h_p[0, :4]),
+                                   np.asarray(h_s[0]), atol=1e-5)
+
+    def test_logits_shape(self):
+        cfg = _cfg()
+        params = init_params(cfg)
+        toks = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        assert mlm_logits(params, toks, cfg).shape == (2, 3, cfg.vocab_size)
+
+
+class TestMasking:
+    def test_recipe_bounds_and_targets(self):
+        cfg = _cfg(mlm_prob=0.3)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(1, cfg.vocab_size - 1, (16, 12))
+        inputs, targets, weights = mask_tokens(toks, cfg, rng)
+        np.testing.assert_array_equal(targets, toks)  # originals kept
+        sel = weights > 0
+        assert sel.any()
+        # unselected positions pass through untouched
+        np.testing.assert_array_equal(inputs[~sel], toks[~sel])
+        # most selected positions carry [MASK] (80% branch)
+        frac_mask = (inputs[sel] == cfg.mask_id).mean()
+        assert 0.5 < frac_mask <= 1.0
+
+    def test_pad_never_selected(self):
+        cfg = _cfg()
+        rng = np.random.default_rng(1)
+        toks = np.full((4, 8), cfg.pad_token_id)
+        toks[:, :3] = 7
+        _, _, weights = mask_tokens(toks, cfg, rng)
+        assert (weights[:, 3:] == 0).all()
+
+    def test_random_branch_never_injects_pad(self):
+        """A 'random' replacement drawing the pad id would make a real
+        position invisible as a key (key_mask comes from the corrupted
+        inputs) — the draw must exclude pad for ANY pad_token_id."""
+        cfg = _cfg(pad_token_id=3, mlm_prob=0.9)
+        rng = np.random.default_rng(5)
+        toks = np.full((32, 12), 9)
+        for _ in range(20):
+            inputs, _, _ = mask_tokens(toks, cfg, rng)
+            assert (inputs != cfg.pad_token_id).all()
+
+    def test_bad_schedule_rejected_loudly(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="total_steps"):
+            BertMLM(_cfg(lr_schedule="cosine", total_steps=0))
+        with pytest.raises(ValueError, match="lr_schedule"):
+            BertMLM(_cfg(lr_schedule="consine"))
+
+    def test_at_least_one_selection(self):
+        cfg = _cfg(mlm_prob=1e-9)
+        rng = np.random.default_rng(2)
+        toks = np.full((2, 6), 9)
+        _, _, weights = mask_tokens(toks, cfg, rng)
+        assert weights.sum() >= 1
+
+
+class TestTraining:
+    def test_mlm_learns_deterministic_pattern(self):
+        """Sequences follow token[i+1] = token[i] + 1 (mod small range):
+        with both-side context every masked token is perfectly inferable,
+        so the loss must fall and masked accuracy must become high."""
+        cfg = _cfg(vocab_size=24, mlm_prob=0.25, learning_rate=5e-3)
+        lm = BertMLM(cfg)
+        rng = np.random.default_rng(3)
+        batches = []
+        for _ in range(8):
+            start = rng.integers(1, 10, (16, 1))
+            seq = (start + np.arange(12)[None]) % 20 + 1
+            batches.append(seq)
+        first = lm.fit(batches[0])
+        last = None
+        for _ in range(40):
+            for b in batches:
+                last = lm.fit(b)
+        assert last < first * 0.5, (first, last)
+        acc = lm.masked_accuracy(batches[0], n_draws=4)
+        assert acc > 0.75, acc
+
+    def test_embed_tokens_shape(self):
+        cfg = _cfg()
+        lm = BertMLM(cfg)
+        out = lm.embed_tokens(np.array([[1, 2, 3, 4]]))
+        assert out.shape == (1, 4, cfg.d_model)
