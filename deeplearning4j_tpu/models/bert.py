@@ -32,6 +32,7 @@ from jax import lax
 from deeplearning4j_tpu.models.transformer import (
     Params,
     _adam_update,
+    _donation_kwargs,
     _ln,
     _scheduled_lr,
     _validate_schedule,
@@ -55,7 +56,11 @@ class BertConfig:
     total_steps: int = 0
     mlm_prob: float = 0.15
     pad_token_id: int = 0
-    mask_token_id: Optional[int] = None  # default: vocab_size - 1
+    # [MASK] id. Default claims the TOP id: vocab_size must INCLUDE a
+    # reserved slot at vocab_size-1 (as examples/bert_mlm.py reserves
+    # [PAD]/[MASK] in its VocabCache) — otherwise pass the real id, or
+    # the rarest vocab word silently doubles as the mask marker.
+    mask_token_id: Optional[int] = None
     seed: int = 0
 
     @property
@@ -181,7 +186,6 @@ def make_train_step(cfg: BertConfig):
     discipline shared with the flagship."""
     _validate_schedule(cfg)  # same loud rejection as the flagship's step
 
-    @jax.jit
     def step(params, opt, inputs, targets, weights):
         loss, grads = jax.value_and_grad(mlm_loss)(
             params, inputs, targets, weights, cfg)
@@ -191,7 +195,9 @@ def make_train_step(cfg: BertConfig):
                                    clip_grad_norm=cfg.clip_grad_norm)
         return params, opt, loss
 
-    return step
+    # donate params + Adam m/v on accelerators (the flagship's policy:
+    # optimizer state is ~2/3 of training-state HBM — update in place)
+    return jax.jit(step, **_donation_kwargs())
 
 
 class BertMLM:
@@ -232,6 +238,12 @@ class BertMLM:
             hits += int((pred[m] == np.asarray(targets)[m]).sum())
             total += int(m.sum())
         return hits / max(total, 1)
+
+    def predict_logits(self, tokens) -> np.ndarray:
+        """MLM logits [N, T, V] through the jitted eval surface (the
+        fill-in-the-blank path: argmax at a masked position)."""
+        return np.asarray(self._logits(self.params,
+                                       jnp.asarray(tokens, jnp.int32)))
 
     def embed_tokens(self, tokens) -> np.ndarray:
         """Contextual embeddings [N, T, d] (the feature-extraction use)."""
