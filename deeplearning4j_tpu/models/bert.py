@@ -200,6 +200,97 @@ def make_train_step(cfg: BertConfig):
     return jax.jit(step, **_donation_kwargs())
 
 
+def init_classifier_head(cfg: BertConfig, n_classes: int,
+                         seed: int = 0) -> Params:
+    k = jax.random.PRNGKey(seed)
+    return {"Wc": jax.random.normal(k, (cfg.d_model, n_classes),
+                                    jnp.float32) * 0.02,
+            "bc": jnp.zeros((n_classes,), jnp.float32)}
+
+
+def classify_logits(params: Params, head: Params, tokens: jax.Array,
+                    cfg: BertConfig) -> jax.Array:
+    """Sequence classification [N, C]: mean-pool the encoder's hidden
+    states over NON-PAD positions (no [CLS] convention needed — pooling
+    over real tokens is the mask-aware equivalent; the reference's
+    closest analog is the masked global pooling of its time-series
+    classification path, MultiLayerNetwork masked evaluate :2316), then
+    a linear head."""
+    key_mask = tokens != cfg.pad_token_id
+    h = encode(params, tokens, cfg, key_mask)
+    w = key_mask.astype(h.dtype)[..., None]
+    pooled = jnp.sum(h * w, axis=1) / jnp.maximum(jnp.sum(w, axis=1), 1.0)
+    return pooled @ head["Wc"] + head["bc"]
+
+
+def make_finetune_step(cfg: BertConfig, n_classes: int,
+                       encoder_lr_scale: float = 1.0):
+    """One jitted fine-tune step over encoder + head: cross-entropy on
+    the pooled classification logits; encoder_lr_scale < 1 gives the
+    pretrained encoder a smaller effective LR than the fresh head
+    (discriminative fine-tuning), 0 freezes it entirely.
+
+    The scale is applied to the encoder's UPDATE (new = old + scale *
+    delta), NOT to its gradients: Adam normalizes by m/(sqrt(v)+eps), so
+    scaling gradients by c scales m and sqrt(v) equally and cancels —
+    gradient scaling is a silent no-op for any c in (0, 1). Update
+    scaling also covers the weight-decay term, so scale=0 truly freezes
+    (decay included)."""
+    _validate_schedule(cfg)
+
+    def loss_fn(both, tokens, labels):
+        logits = classify_logits(both["encoder"], both["head"], tokens, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
+                                             axis=-1))
+
+    def step(both, opt, tokens, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(both, tokens, labels)
+        lr = _scheduled_lr(cfg, opt["t"] + 1)
+        new, opt = _adam_update(both, grads, opt, lr,
+                                weight_decay=cfg.weight_decay,
+                                clip_grad_norm=cfg.clip_grad_norm)
+        if encoder_lr_scale != 1.0:
+            new["encoder"] = jax.tree_util.tree_map(
+                lambda old, n: old + encoder_lr_scale * (n - old),
+                both["encoder"], new["encoder"])
+        return new, opt, loss
+
+    return jax.jit(step, **_donation_kwargs())
+
+
+class BertClassifier:
+    """Fine-tune a (pretrained) BertMLM encoder for sequence
+    classification — the pretrain -> fine-tune arc."""
+
+    def __init__(self, mlm: "BertMLM", n_classes: int,
+                 encoder_lr_scale: float = 1.0):
+        self.cfg = mlm.cfg
+        self.n_classes = n_classes
+        self.state = {"encoder": mlm.params,
+                      "head": init_classifier_head(mlm.cfg, n_classes,
+                                                   seed=mlm.cfg.seed + 1)}
+        self.opt = init_opt_state(self.state)
+        self._step = make_finetune_step(mlm.cfg, n_classes,
+                                        encoder_lr_scale)
+        self._logits = jax.jit(
+            lambda st, t: classify_logits(st["encoder"], st["head"], t,
+                                          self.cfg))
+
+    def fit(self, tokens, labels) -> float:
+        self.state, self.opt, loss = self._step(
+            self.state, self.opt, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(labels, jnp.int32))
+        return float(loss)
+
+    def predict(self, tokens) -> np.ndarray:
+        return np.asarray(jnp.argmax(
+            self._logits(self.state, jnp.asarray(tokens, jnp.int32)), -1))
+
+    def accuracy(self, tokens, labels) -> float:
+        return float((self.predict(tokens) == np.asarray(labels)).mean())
+
+
 class BertMLM:
     """User surface: masked-LM pretraining + masked-token evaluation."""
 
