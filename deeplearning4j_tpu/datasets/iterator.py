@@ -23,10 +23,30 @@ import jax
 import numpy as np
 
 
+def _float_dtype_of(a) -> np.dtype:
+    """Preserve an existing floating dtype through the in-place DataSet
+    utilities (the forced-x64 test regime runs f64 pipelines; a silent
+    f32 downcast mid-pipeline would poison equivalence comparisons);
+    integer/bool inputs standardize to float32."""
+    dt = np.asarray(a).dtype
+    return dt if np.issubdtype(dt, np.floating) else np.dtype(np.float32)
+
+
 @dataclass
 class DataSet:
     """features/labels (+ optional masks) minibatch (reference org.nd4j DataSet
-    as used throughout dl4j; masks per TestVariableLengthTS semantics)."""
+    as used throughout dl4j; masks per TestVariableLengthTS semantics).
+
+    Carries the reference DataSet's in-place utility surface in usage
+    order (counted across /root/reference *.java):
+    normalizeZeroMeanZeroUnitVariance (31 uses — e.g.
+    deeplearning4j-core/.../nn/updater/TestDecayPolicies.java:392),
+    sample (19), shuffle (15 —
+    deeplearning4j-core/.../nn/layers/OutputLayerTest.java:83),
+    splitTestAndTrain (9 —
+    deeplearning4j-ui-parent/.../ui/ManualTests.java:300),
+    normalize (7), scale (3 — ManualTests.java:299) — the preprocessing
+    idiom of every 2016 dl4j example."""
 
     features: np.ndarray
     labels: np.ndarray
@@ -35,6 +55,88 @@ class DataSet:
 
     def num_examples(self) -> int:
         return int(np.asarray(self.features).shape[0])
+
+    def normalize_zero_mean_zero_unit_variance(self) -> "DataSet":
+        """Per-COLUMN standardization of the features, in place (the
+        reference's column-wise mean/std over the batch dim); zero-std
+        columns divide by 1 instead of exploding."""
+        f = np.asarray(self.features, np.float64)
+        axis = 0
+        mean = f.mean(axis=axis, keepdims=True)
+        std = f.std(axis=axis, keepdims=True)
+        std = np.where(std == 0, 1.0, std)
+        self.features = ((f - mean) / std).astype(_float_dtype_of(
+            self.features))
+        return self
+
+    def normalize(self) -> "DataSet":
+        """Scale features into [0, 1] by the global min/max (the
+        reference's normalize())."""
+        f = np.asarray(self.features, np.float64)
+        lo, hi = f.min(), f.max()
+        span = (hi - lo) or 1.0
+        self.features = ((f - lo) / span).astype(_float_dtype_of(
+            self.features))
+        return self
+
+    def scale(self, by: float = 0.0) -> "DataSet":
+        """Divide features by `by` (default: the max absolute value —
+        the reference's scale() divides by max)."""
+        f = np.asarray(self.features, np.float64)
+        d = by if by else (np.abs(f).max() or 1.0)
+        self.features = (f / d).astype(_float_dtype_of(self.features))
+        return self
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        """Permute examples in place (features/labels/masks together)."""
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = np.asarray(self.features)[idx]
+        self.labels = np.asarray(self.labels)[idx]
+        if self.features_mask is not None:
+            self.features_mask = np.asarray(self.features_mask)[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = np.asarray(self.labels_mask)[idx]
+        return self
+
+    def sample(self, n: int, seed: Optional[int] = None,
+               with_replacement: bool = False) -> "DataSet":
+        """A new DataSet of n examples drawn from this one (the
+        reference's sample(numSamples[, rng, withReplacement]))."""
+        rng = np.random.default_rng(seed)
+        total = self.num_examples()
+        if with_replacement:
+            idx = rng.integers(0, total, n)
+        else:
+            if n > total:
+                raise ValueError(
+                    f"sample({n}) without replacement from {total}")
+            idx = rng.permutation(total)[:n]
+        take = lambda a: None if a is None else np.asarray(a)[idx]
+        return DataSet(take(self.features), take(self.labels),
+                       take(self.features_mask), take(self.labels_mask))
+
+    def split_test_and_train(self, n_train: int) -> "SplitTestAndTrain":
+        """First n_train examples -> train, rest -> test (the reference's
+        contiguous split; shuffle() first for a random split)."""
+        total = self.num_examples()
+        if not 0 < n_train < total:
+            raise ValueError(f"n_train {n_train} outside (0, {total})")
+        cut = lambda a, s: None if a is None else np.asarray(a)[s]
+        mk = lambda s: DataSet(cut(self.features, s), cut(self.labels, s),
+                               cut(self.features_mask, s),
+                               cut(self.labels_mask, s))
+        return SplitTestAndTrain(mk(slice(0, n_train)),
+                                 mk(slice(n_train, total)))
+
+
+@dataclass
+class SplitTestAndTrain:
+    """Return value of DataSet.split_test_and_train (reference
+    org.nd4j SplitTestAndTrain: getTrain()/getTest())."""
+
+    train: "DataSet"
+    test: "DataSet"
 
 
 @dataclass

@@ -202,6 +202,11 @@ def make_train_step(cfg: BertConfig):
 
 def init_classifier_head(cfg: BertConfig, n_classes: int,
                          seed: int = 0) -> Params:
+    """Fresh linear classification head (the reference's fine-tune-era
+    analog is replacing the output layer atop pretrained weights —
+    its TransferLearning API is post-0.4; the 0.4 idiom is the
+    pretrain-then-finetune DBN flow, MultiLayerNetwork.pretrain :1103
+    followed by supervised fit)."""
     k = jax.random.PRNGKey(seed)
     return {"Wc": jax.random.normal(k, (cfg.d_model, n_classes),
                                     jnp.float32) * 0.02,

@@ -215,5 +215,11 @@ class TestFineTuning:
                   for a, b in zip(jax.tree_util.tree_leaves(after),
                                   jax.tree_util.tree_leaves(before)))
         assert dev == 0.0, f"frozen encoder moved by {dev}"
+        # head must have MOVED from its init (a regression freezing the
+        # whole tree would leave it at the random init exactly)
+        from deeplearning4j_tpu.models.bert import init_classifier_head
+
+        hw0 = np.asarray(init_classifier_head(cfg, 2,
+                                              seed=cfg.seed + 1)["Wc"])
         hw = np.asarray(clf.state["head"]["Wc"])
-        assert np.abs(hw).sum() > 0  # head did train
+        assert float(np.max(np.abs(hw - hw0))) > 1e-6  # head did train

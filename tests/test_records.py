@@ -161,3 +161,90 @@ class TestMultiDataSetIterator:
         np.testing.assert_array_equal(mds.labels_list[0],
                                       [[1, 0, 0], [0, 1, 0]])
         assert batches[1].features_list[0].shape == (1, 2)
+
+
+class TestDataSetUtilitySurface:
+    """The reference DataSet's in-place utility methods, in usage order
+    (normalizeZeroMeanZeroUnitVariance 31 uses, sample 19, shuffle 15,
+    splitTestAndTrain 9, normalize 7, scale 3 across the reference)."""
+
+    def _ds(self, n=10, f=4, seed=0):
+        from deeplearning4j_tpu.datasets.iterator import DataSet
+
+        rng = np.random.default_rng(seed)
+        return DataSet(rng.standard_normal((n, f)) * 3 + 5,
+                       np.eye(2)[rng.integers(0, 2, n)])
+
+    def test_standardize_columns(self):
+        ds = self._ds()
+        ds.normalize_zero_mean_zero_unit_variance()
+        np.testing.assert_allclose(ds.features.mean(0), 0, atol=1e-6)
+        np.testing.assert_allclose(ds.features.std(0), 1, atol=1e-5)
+
+    def test_standardize_constant_column_safe(self):
+        ds = self._ds()
+        ds.features[:, 1] = 7.0
+        ds.normalize_zero_mean_zero_unit_variance()
+        assert np.isfinite(ds.features).all()
+        np.testing.assert_allclose(ds.features[:, 1], 0, atol=1e-6)
+
+    def test_normalize_to_unit_range(self):
+        ds = self._ds()
+        ds.normalize()
+        assert ds.features.min() == 0.0 and ds.features.max() == 1.0
+
+    def test_scale_by_max_abs(self):
+        ds = self._ds()
+        m = np.abs(ds.features).max()
+        ref = np.asarray(ds.features) / m
+        ds.scale()
+        np.testing.assert_allclose(ds.features, ref, rtol=1e-6)
+
+    def test_shuffle_keeps_pairs(self):
+        ds = self._ds()
+        pairs = {tuple(np.round(fv, 6)): tuple(lv)
+                 for fv, lv in zip(ds.features, ds.labels)}
+        ds.shuffle(seed=3)
+        for fv, lv in zip(ds.features, ds.labels):
+            assert pairs[tuple(np.round(fv, 6))] == tuple(lv)
+
+    def test_sample_without_replacement_unique(self):
+        ds = self._ds(n=8)
+        s = ds.sample(8, seed=1)
+        assert s.num_examples() == 8
+        assert len({tuple(np.round(r, 6)) for r in s.features}) == 8
+        import pytest
+
+        with pytest.raises(ValueError):
+            ds.sample(9)
+
+    def test_sample_with_replacement(self):
+        ds = self._ds(n=4)
+        s = ds.sample(16, seed=2, with_replacement=True)
+        assert s.num_examples() == 16
+
+    def test_split_test_and_train(self):
+        ds = self._ds(n=10)
+        sp = ds.split_test_and_train(7)
+        assert sp.train.num_examples() == 7
+        assert sp.test.num_examples() == 3
+        np.testing.assert_array_equal(sp.train.features,
+                                      np.asarray(ds.features)[:7])
+        import pytest
+
+        with pytest.raises(ValueError):
+            ds.split_test_and_train(10)
+
+    def test_float_dtype_preserved_through_utilities(self):
+        """f64 pipelines (the forced-x64 equivalence regime) must not be
+        silently downcast by any in-place utility; int features
+        standardize to float32."""
+        from deeplearning4j_tpu.datasets.iterator import DataSet
+
+        f64 = self._ds()
+        assert np.asarray(f64.features).dtype == np.float64
+        f64.normalize_zero_mean_zero_unit_variance().normalize().scale()
+        assert f64.features.dtype == np.float64
+        ints = DataSet(np.arange(12).reshape(4, 3), np.eye(2)[[0, 1, 0, 1]])
+        ints.normalize()
+        assert ints.features.dtype == np.float32
