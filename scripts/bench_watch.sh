@@ -7,13 +7,16 @@
 # filling the gaps until BENCH_PARTIAL.json is clean. bench.py merges
 # per-leg results across passes, so each contact window only has to add
 # the legs still missing.
-cd /root/repo || exit 1
+# BENCH_WATCH_DIR / BENCH_WATCH_AXON_SITE exist so the state machine can
+# run under the shell-harness test (tests/test_bench_watch_sh.py) with a
+# stub repo + stub jax; production uses the defaults
+cd "${BENCH_WATCH_DIR:-/root/repo}" || exit 1
 # pidfile so restarts can kill the exact process (grep/pkill patterns
 # match the restarting shell's own args and kill the wrong process)
 echo $$ > .bench_watch.pid
 # axon plugin registration needs /root/.axon_site on PYTHONPATH (CLAUDE.md);
 # without it jax silently falls back to CPU and the probe would loop forever
-export PYTHONPATH="/root/repo:/root/.axon_site${PYTHONPATH:+:$PYTHONPATH}"
+export PYTHONPATH="$PWD:${BENCH_WATCH_AXON_SITE-/root/.axon_site}${PYTHONPATH:+:$PYTHONPATH}"
 PROBE='
 import threading, sys
 res = {}
