@@ -181,9 +181,7 @@ def mask_tokens(tokens: np.ndarray, cfg: BertConfig,
     return inputs, tokens, weights
 
 
-def make_train_step(cfg: BertConfig):
-    """One jitted optimizer step: masked loss + Adam, the whole-step-jit
-    discipline shared with the flagship."""
+def _build_mlm_step(cfg: BertConfig):
     _validate_schedule(cfg)  # same loud rejection as the flagship's step
 
     def step(params, opt, inputs, targets, weights):
@@ -195,9 +193,28 @@ def make_train_step(cfg: BertConfig):
                                    clip_grad_norm=cfg.clip_grad_norm)
         return params, opt, loss
 
+    return step
+
+
+def make_train_step(cfg: BertConfig):
+    """One jitted optimizer step: masked loss + Adam, the whole-step-jit
+    discipline shared with the flagship."""
     # donate params + Adam m/v on accelerators (the flagship's policy:
     # optimizer state is ~2/3 of training-state HBM — update in place)
-    return jax.jit(step, **_donation_kwargs())
+    return jax.jit(_build_mlm_step(cfg), **_donation_kwargs())
+
+
+def make_train_multi_step(cfg: BertConfig):
+    """K optimizer steps fused into ONE XLA program (lax.scan over
+    stacked pre-masked batches [K, N, T] — the flagship's fit_batches
+    dispatch amortization, transformer.make_train_multi_step, applied to
+    the MLM objective: K steps cost one ~5ms tunnel dispatch instead of
+    K). Serially equivalent to K make_train_step calls on the same
+    masked batches."""
+    from deeplearning4j_tpu.models.transformer import _multi_from_step
+
+    return jax.jit(_multi_from_step(_build_mlm_step(cfg)),
+                   **_donation_kwargs())
 
 
 def init_classifier_head(cfg: BertConfig, n_classes: int,
@@ -306,6 +323,7 @@ class BertMLM:
         self.params = init_params(cfg)
         self.opt = init_opt_state(self.params)
         self._step = make_train_step(cfg)
+        self._multi = None  # built on first fit_batches
         # jitted eval surfaces too (whole-step-jit discipline: ~5ms per
         # dispatch through the remote tunnel makes eager eval pathological)
         self._logits = jax.jit(lambda p, t: mlm_logits(p, t, cfg))
@@ -320,6 +338,28 @@ class BertMLM:
             self.params, self.opt, jnp.asarray(inputs, jnp.int32),
             jnp.asarray(targets, jnp.int32), jnp.asarray(weights))
         return float(loss)
+
+    def fit_batches(self, tokens_k) -> float:
+        """K masked-LM steps in ONE XLA program: [K, N, T] stacked
+        batches, masking drawn host-side per batch from the same rng
+        stream fit() uses (so K fit() calls and one fit_batches on the
+        same batches take identical optimizer steps). Returns the last
+        step's loss."""
+        tokens_k = np.asarray(tokens_k)
+        if tokens_k.ndim != 3 or tokens_k.shape[0] == 0:
+            raise ValueError(
+                f"fit_batches expects stacked batches [K, N, T] with "
+                f"K >= 1, got shape {tokens_k.shape} (a single [N, T] "
+                "batch belongs in fit())")
+        drawn = [mask_tokens(b, self.cfg, self._rng) for b in tokens_k]
+        stack = lambda i, dt: jnp.asarray(np.stack([d[i] for d in drawn]),
+                                          dt)
+        if self._multi is None:
+            self._multi = make_train_multi_step(self.cfg)
+        self.params, self.opt, losses = self._multi(
+            self.params, self.opt, stack(0, jnp.int32),
+            stack(1, jnp.int32), stack(2, jnp.float32))
+        return float(losses[-1])
 
     def masked_accuracy(self, tokens, n_draws: int = 1) -> float:
         """Fraction of masked positions predicted exactly (argmax)."""

@@ -1025,15 +1025,16 @@ def make_pipeline_train_multi_step(cfg: TransformerConfig, mesh: Mesh, *,
 
 def _multi_from_step(step):
     """Wrap a pure train step into a K-step lax.scan over stacked batches
-    (shared by the dense and pipelined multi-step factories)."""
-    def multi(params, opt, tokens_k, targets_k):
-        def body(carry, xy):
+    (shared by the dense, pipelined, and BERT-MLM multi-step factories —
+    variadic so steps with any number of data stacks fit: (tokens,
+    targets) here, (inputs, targets, weights) for the MLM)."""
+    def multi(params, opt, *stacks):
+        def body(carry, xs):
             params, opt = carry
-            params, opt, loss = step(params, opt, xy[0], xy[1])
+            params, opt, loss = step(params, opt, *xs)
             return (params, opt), loss
 
-        (params, opt), losses = lax.scan(body, (params, opt),
-                                         (tokens_k, targets_k))
+        (params, opt), losses = lax.scan(body, (params, opt), stacks)
         return params, opt, losses
 
     return multi

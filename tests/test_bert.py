@@ -223,3 +223,25 @@ class TestFineTuning:
                                               seed=cfg.seed + 1)["Wc"])
         hw = np.asarray(clf.state["head"]["Wc"])
         assert float(np.max(np.abs(hw - hw0))) > 1e-6  # head did train
+
+
+class TestFusedMultiStep:
+    def test_fit_batches_equals_sequential_fits(self):
+        """K steps in one lax.scan program == K fit() calls on the same
+        batches (same rng stream => same mask draws => identical
+        optimizer trajectory, the flagship's fit_batches contract)."""
+        cfg = _cfg(vocab_size=24)
+        rng = np.random.default_rng(9)
+        batches = rng.integers(1, 20, (3, 8, 12))
+
+        seq = BertMLM(cfg)
+        for b in batches:
+            last_seq = seq.fit(b)
+
+        fused = BertMLM(cfg)
+        last_fused = fused.fit_batches(batches)
+
+        np.testing.assert_allclose(last_fused, last_seq, rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(seq.params),
+                        jax.tree_util.tree_leaves(fused.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
