@@ -381,6 +381,32 @@ class BertMLM:
         return np.asarray(self._logits(self.params,
                                        jnp.asarray(tokens, jnp.int32)))
 
+    def save(self, path: str) -> None:
+        """Checkpoint in the framework's ModelSerializer zip layout
+        (shared writer — utils/serialization.write_flagship_zip;
+        reference ModelSerializer.java:70-110 three-part semantic:
+        configuration + coefficients + updater)."""
+        from deeplearning4j_tpu.utils.serialization import (
+            write_flagship_zip,
+        )
+
+        write_flagship_zip(path, "BertMLM", self.cfg, self.params,
+                           self.opt)
+
+    @classmethod
+    def load(cls, path: str, load_updater: bool = True) -> "BertMLM":
+        from deeplearning4j_tpu.utils.serialization import (
+            _npz_bytes_into_tree,
+            read_flagship_zip,
+        )
+
+        cfg_dict, coeff, upd = read_flagship_zip(path, "BertMLM")
+        lm = cls(BertConfig(**cfg_dict))
+        lm.params = _npz_bytes_into_tree(coeff, lm.params)
+        if load_updater and upd is not None:
+            lm.opt = _npz_bytes_into_tree(upd, lm.opt)
+        return lm
+
     def embed_tokens(self, tokens) -> np.ndarray:
         """Contextual embeddings [N, T, d] (the feature-extraction use)."""
         return np.asarray(self._encode(self.params,

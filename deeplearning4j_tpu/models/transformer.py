@@ -1210,48 +1210,34 @@ class TransformerLM:
 
     def save(self, path: str) -> None:
         """Checkpoint in the framework's ModelSerializer zip layout
-        (utils/serialization.py — reference ModelSerializer.java:70-110
-        three-part semantic: configuration + coefficients + updater)."""
-        import json
-        import zipfile
-
+        (shared writer — utils/serialization.write_flagship_zip;
+        reference ModelSerializer.java:70-110 three-part semantic:
+        configuration + coefficients + updater)."""
         from deeplearning4j_tpu.utils.serialization import (
-            FORMAT_VERSION,
-            _tree_to_npz_bytes,
+            write_flagship_zip,
         )
 
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("configuration.json",
-                       json.dumps(dataclasses.asdict(self.cfg)))
-            z.writestr("coefficients.npz", _tree_to_npz_bytes(self.params))
-            z.writestr("updater.npz", _tree_to_npz_bytes(self.opt))
-            z.writestr("metadata.json", json.dumps({
-                "format_version": FORMAT_VERSION,
-                "model_class": "TransformerLM",
-            }))
+        write_flagship_zip(path, "TransformerLM", self.cfg, self.params,
+                           self.opt)
 
     @classmethod
     def load(cls, path: str, mesh: Optional[Mesh] = None,
              load_updater: bool = True) -> "TransformerLM":
-        import json
-        import zipfile
-
         from deeplearning4j_tpu.utils.serialization import (
             _npz_bytes_into_tree,
+            read_flagship_zip,
         )
 
-        with zipfile.ZipFile(path, "r") as z:
-            cfg = TransformerConfig(
-                **json.loads(z.read("configuration.json").decode()))
-            lm = cls(cfg, mesh=mesh)
-            lm.params = _npz_bytes_into_tree(z.read("coefficients.npz"),
-                                             lm.params)
-            if load_updater and "updater.npz" in z.namelist():
-                lm.opt = _npz_bytes_into_tree(z.read("updater.npz"), lm.opt)
-                # optimizer step count IS the training iteration (same
-                # contract as from_state): resumed runs must not re-emit
-                # earlier iteration numbers to listeners
-                lm.iteration = int(lm.opt["t"])
+        cfg_dict, coeff, upd = read_flagship_zip(path, "TransformerLM")
+        cfg = TransformerConfig(**cfg_dict)
+        lm = cls(cfg, mesh=mesh)
+        lm.params = _npz_bytes_into_tree(coeff, lm.params)
+        if load_updater and upd is not None:
+            lm.opt = _npz_bytes_into_tree(upd, lm.opt)
+            # optimizer step count IS the training iteration (same
+            # contract as from_state): resumed runs must not re-emit
+            # earlier iteration numbers to listeners
+            lm.iteration = int(lm.opt["t"])
         if mesh is not None:
             lm.params = shard_params_for_mesh(lm.params, cfg, mesh)
         return lm

@@ -54,6 +54,45 @@ def _npz_bytes_into_tree(data: bytes, template):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def write_flagship_zip(path: str, model_class: str, cfg, params,
+                       opt) -> None:
+    """SHARED writer for dataclass-configured flagship models
+    (TransformerLM, BertMLM): the ModelSerializer three-part zip layout
+    (reference ModelSerializer.java:70-110 — configuration +
+    coefficients + updater) with the model_class recorded for restore
+    dispatch. One implementation, so a format change can never leave a
+    model family behind."""
+    import dataclasses
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json",
+                   json.dumps(dataclasses.asdict(cfg)))
+        z.writestr("coefficients.npz", _tree_to_npz_bytes(params))
+        z.writestr("updater.npz", _tree_to_npz_bytes(opt))
+        z.writestr("metadata.json", json.dumps({
+            "format_version": FORMAT_VERSION,
+            "model_class": model_class,
+        }))
+
+
+def read_flagship_zip(path: str, expected_class: str):
+    """SHARED reader: returns (cfg_dict, coefficients_bytes,
+    updater_bytes_or_None). Rejects a checkpoint of a different model
+    class loudly; a missing updater entry yields None (weights-only
+    checkpoints restore gracefully)."""
+    with zipfile.ZipFile(path, "r") as z:
+        meta = json.loads(z.read("metadata.json").decode())
+        got = meta.get("model_class")
+        if got != expected_class:
+            raise ValueError(
+                f"checkpoint holds {got!r}, not {expected_class}")
+        cfg = json.loads(z.read("configuration.json").decode())
+        coeff = z.read("coefficients.npz")
+        upd = (z.read("updater.npz")
+               if "updater.npz" in z.namelist() else None)
+    return cfg, coeff, upd
+
+
 class ModelSerializer:
     @staticmethod
     def write_model(net, path: str, save_updater: bool = True) -> None:
@@ -171,6 +210,16 @@ class ModelSerializer:
                 "ParallelWrapper to train on the mesh)",
                 meta.get("model_class", "MultiLayerNetwork"),
             )
+        if meta.get("model_class") == "BertMLM":
+            from deeplearning4j_tpu.models.bert import BertMLM
+
+            return BertMLM.load(path, load_updater=load_updater)
         if meta.get("model_class") == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path, load_updater)
+        if meta.get("model_class") not in (None, "MultiLayerNetwork"):
+            # a clear rejection beats restore_multi_layer_network dying
+            # on a foreign configuration.json deep in from_json
+            raise ValueError(
+                f"unknown checkpoint model_class "
+                f"{meta.get('model_class')!r} at {path}")
         return ModelSerializer.restore_multi_layer_network(path, load_updater)
