@@ -7,6 +7,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax
+
+# force CPU BEFORE first backend use: the axon TPU plugin hangs
+# forever initializing a dead remote tunnel (CLAUDE.md); demos run
+# in seconds on CPU and scale to TPU unchanged via this one line
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 
 from deeplearning4j_tpu.datasets.fetchers import load_mnist_info
