@@ -289,6 +289,7 @@ class BertClassifier:
                  encoder_lr_scale: float = 1.0):
         self.cfg = mlm.cfg
         self.n_classes = n_classes
+        self._encoder_lr_scale = encoder_lr_scale
         self.state = {"encoder": mlm.params,
                       "head": init_classifier_head(mlm.cfg, n_classes,
                                                    seed=mlm.cfg.seed + 1)}
@@ -311,6 +312,39 @@ class BertClassifier:
 
     def accuracy(self, tokens, labels) -> float:
         return float((self.predict(tokens) == np.asarray(labels)).mean())
+
+    def save(self, path: str) -> None:
+        """Checkpoint the fine-tuned encoder+head through the shared
+        flagship zip layout (coefficients = the {'encoder','head'} state
+        tree; n_classes/encoder_lr_scale recorded in metadata so load
+        rebuilds the exact model)."""
+        from deeplearning4j_tpu.utils.serialization import (
+            write_flagship_zip,
+        )
+
+        write_flagship_zip(
+            path, "BertClassifier", self.cfg, self.state, self.opt,
+            extra_meta={"n_classes": self.n_classes,
+                        "encoder_lr_scale": self._encoder_lr_scale})
+
+    @classmethod
+    def load(cls, path: str,
+             load_updater: bool = True) -> "BertClassifier":
+        from deeplearning4j_tpu.utils.serialization import (
+            _npz_bytes_into_tree,
+            read_flagship_zip,
+        )
+
+        cfg_dict, coeff, upd, meta = read_flagship_zip(
+            path, "BertClassifier")
+        mlm = BertMLM(BertConfig(**cfg_dict))
+        clf = cls(mlm, n_classes=int(meta["n_classes"]),
+                  encoder_lr_scale=float(meta.get("encoder_lr_scale",
+                                                  1.0)))
+        clf.state = _npz_bytes_into_tree(coeff, clf.state)
+        if load_updater and upd is not None:
+            clf.opt = _npz_bytes_into_tree(upd, clf.opt)
+        return clf
 
 
 class BertMLM:
@@ -400,7 +434,7 @@ class BertMLM:
             read_flagship_zip,
         )
 
-        cfg_dict, coeff, upd = read_flagship_zip(path, "BertMLM")
+        cfg_dict, coeff, upd, _ = read_flagship_zip(path, "BertMLM")
         lm = cls(BertConfig(**cfg_dict))
         lm.params = _npz_bytes_into_tree(coeff, lm.params)
         if load_updater and upd is not None:

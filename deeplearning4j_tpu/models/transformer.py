@@ -1228,7 +1228,7 @@ class TransformerLM:
             read_flagship_zip,
         )
 
-        cfg_dict, coeff, upd = read_flagship_zip(path, "TransformerLM")
+        cfg_dict, coeff, upd, _ = read_flagship_zip(path, "TransformerLM")
         cfg = TransformerConfig(**cfg_dict)
         lm = cls(cfg, mesh=mesh)
         lm.params = _npz_bytes_into_tree(coeff, lm.params)

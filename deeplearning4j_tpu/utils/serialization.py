@@ -55,7 +55,7 @@ def _npz_bytes_into_tree(data: bytes, template):
 
 
 def write_flagship_zip(path: str, model_class: str, cfg, params,
-                       opt) -> None:
+                       opt, extra_meta: dict = None) -> None:
     """SHARED writer for dataclass-configured flagship models
     (TransformerLM, BertMLM): the ModelSerializer three-part zip layout
     (reference ModelSerializer.java:70-110 — configuration +
@@ -72,14 +72,15 @@ def write_flagship_zip(path: str, model_class: str, cfg, params,
         z.writestr("metadata.json", json.dumps({
             "format_version": FORMAT_VERSION,
             "model_class": model_class,
+            **(extra_meta or {}),
         }))
 
 
 def read_flagship_zip(path: str, expected_class: str):
     """SHARED reader: returns (cfg_dict, coefficients_bytes,
-    updater_bytes_or_None). Rejects a checkpoint of a different model
-    class loudly; a missing updater entry yields None (weights-only
-    checkpoints restore gracefully)."""
+    updater_bytes_or_None, metadata_dict). Rejects a checkpoint of a
+    different model class loudly; a missing updater entry yields None
+    (weights-only checkpoints restore gracefully)."""
     with zipfile.ZipFile(path, "r") as z:
         meta = json.loads(z.read("metadata.json").decode())
         got = meta.get("model_class")
@@ -90,7 +91,7 @@ def read_flagship_zip(path: str, expected_class: str):
         coeff = z.read("coefficients.npz")
         upd = (z.read("updater.npz")
                if "updater.npz" in z.namelist() else None)
-    return cfg, coeff, upd
+    return cfg, coeff, upd, meta
 
 
 class ModelSerializer:
@@ -214,6 +215,10 @@ class ModelSerializer:
             from deeplearning4j_tpu.models.bert import BertMLM
 
             return BertMLM.load(path, load_updater=load_updater)
+        if meta.get("model_class") == "BertClassifier":
+            from deeplearning4j_tpu.models.bert import BertClassifier
+
+            return BertClassifier.load(path, load_updater=load_updater)
         if meta.get("model_class") == "ComputationGraph":
             return ModelSerializer.restore_computation_graph(path, load_updater)
         if meta.get("model_class") not in (None, "MultiLayerNetwork"):

@@ -304,3 +304,36 @@ class TestCheckpoint:
         for a, b in zip(jax.tree_util.tree_leaves(lm.params),
                         jax.tree_util.tree_leaves(back.params)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_classifier_save_load_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.models.bert import BertClassifier
+
+        cfg = _cfg(vocab_size=24)
+        lm = BertMLM(cfg)
+        clf = BertClassifier(lm, n_classes=3, encoder_lr_scale=0.5)
+        rng = np.random.default_rng(12)
+        X = rng.integers(1, 20, (8, 12))
+        y = rng.integers(0, 3, 8)
+        for _ in range(3):
+            clf.fit(X, y)
+        p = str(tmp_path / "clf.zip")
+        clf.save(p)
+
+        back = BertClassifier.load(p)
+        assert back.n_classes == 3
+        assert back._encoder_lr_scale == 0.5
+        np.testing.assert_array_equal(back.predict(X), clf.predict(X))
+        # continued fine-tuning takes the identical next step
+        np.testing.assert_allclose(clf.fit(X, y), back.fit(X, y),
+                                   rtol=1e-6)
+
+    def test_model_serializer_dispatches_classifier(self, tmp_path):
+        from deeplearning4j_tpu.models.bert import BertClassifier
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        cfg = _cfg(vocab_size=24)
+        clf = BertClassifier(BertMLM(cfg), n_classes=2)
+        p = str(tmp_path / "clf.zip")
+        clf.save(p)
+        back = ModelSerializer.restore(p)
+        assert isinstance(back, BertClassifier)
