@@ -15,14 +15,30 @@ re-designed idiomatically for TPU on JAX/XLA:
   (reference: MultiLayerNetwork.java:349-440) — contiguity is XLA's job.
 
 Package layout:
-  ops/        tensor substrate: dtype policy, RNG policy, activation registry
-  nn/         configs (builder DSL + JSON), layers, containers
-  optimize/   updaters, LR schedules, solvers, listeners
-  datasets/   DataSetIterator protocol, fetchers, async prefetch
-  eval/       Evaluation / RegressionEvaluation / ConfusionMatrix
-  parallel/   device-mesh data parallelism, parameter-averaging mode
-  models/     LeNet-5, ResNet-50, char-RNN, word2vec, ...
-  utils/      serialization (checkpoints), gradient checking
+  ops/           tensor substrate: dtype policy, RNG, activations, pallas
+                 kernels behind the measured-win gate
+  nn/            configs (builder DSL + JSON/YAML), layers, containers
+  optimize/      updaters, LR schedules, solvers, listeners
+  datasets/      DataSet (+ reference utility surface), iterators,
+                 fetchers, async prefetch
+  eval/          Evaluation / RegressionEvaluation / ROC / ConfusionMatrix
+  parallel/      mesh parallelism (dp/tp/pp/sp/ep), parameter averaging,
+                 multi-host (jax.distributed, process-local feeding),
+                 training master + exported-dataset plane, statetracker
+  models/        LeNet-5, AlexNet, VGG, GoogLeNet, ResNet-50, DBN,
+                 char-RNN, TransformerLM (flagship), BertMLM/Classifier
+  nlp/           word2vec/GloVe/paragraph vectors, tokenizers, treebank
+  graph/         DeepWalk + random walkers
+  clustering/    KMeans + KD/Quad/SP/VP trees
+  plot/          t-SNE (exact + Barnes-Hut), filter/reconstruction renders
+  earlystopping/ terminations, savers, trainers (+ distributed)
+  streaming/     HTTP model serving (predict + generate), record serde
+  ui/            stdlib HTTP dashboards, SVG chart DSL, listeners
+  provision/     TPU pod-slice setup, GCS dataset/artifact IO
+  native/        C++ host runtime (idx/CSV/npz parsing, shuffling,
+                 prefetch ring buffers) via ctypes, pure-Python fallbacks
+  utils/         serialization (zip + sharded orbax), gradient checking,
+                 profiling (xplane), equivalence harness
 """
 
 __version__ = "0.1.0"
