@@ -998,6 +998,39 @@ _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_PARTIAL.json")
 
+# process birth time, against the round-start marker: a bench pass that
+# outlives its round (the watcher that launched it was killed at a round
+# boundary but the pass survived) must never write stale rows into the
+# NEW round's artifact (ADVICE r4 #1 — the group kill is the first line
+# of defense; this guard is the second)
+_START_TS = time.time()
+_ROUND_MARKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_round_start")
+
+
+def _round_is_stale() -> bool:
+    # Signal 1 — spawner identity: the watcher exports BENCH_WATCH_ROUND
+    # (the marker's mtime at ITS start). A zombie watcher from a prior
+    # round hands its children the OLD identity; any mismatch with the
+    # current marker means the spawning watcher's round is over. This is
+    # the check that catches freshly spawned children (whose own birth
+    # time is always newer than the marker, blinding signal 2).
+    # "0"/empty = no identity (a failed stat at watcher start must not
+    # doom every child of an otherwise healthy watcher to stale-abort)
+    spawner_round = os.environ.get("BENCH_WATCH_ROUND")
+    if spawner_round and spawner_round != "0":
+        try:
+            if int(os.path.getmtime(_ROUND_MARKER)) != int(spawner_round):
+                return True
+        except (OSError, ValueError):
+            return True  # marker vanished mid-boundary / garbled id
+    # Signal 2 — own birth time: covers a round boundary that happens
+    # WHILE this process is running (marker re-touched after we started).
+    try:
+        return os.path.getmtime(_ROUND_MARKER) > _START_TS
+    except OSError:
+        return False  # no marker yet: round hygiene hasn't run — write ok
+
 
 def _persist_partial(extras: dict) -> None:
     """Append-as-you-go artifact: update BENCH_PARTIAL.json after EVERY
@@ -1012,6 +1045,13 @@ def _persist_partial(extras: dict) -> None:
     retry overwrote the measured CPU legs at 04:08). A measured row
     always replaces an older row; an error row only annotates a measured
     row with last_error/last_error_ts."""
+    if _round_is_stale():
+        # a NEW round started after this process did: these rows belong to
+        # the previous round and must not pollute the fresh artifact. The
+        # pass itself is pointless now — stop it.
+        _log("round marker is newer than this bench process; aborting "
+             "stale pass without writing")
+        raise SystemExit(3)
     try:
         with open(_PARTIAL_PATH) as f:
             legs = json.load(f).get("legs", {})
@@ -1055,6 +1095,15 @@ def _fill_skip(prev, quick: bool) -> bool:
 
 
 def main():
+    # fast-abort for zombie-watcher children (same rationale as the
+    # startup guard in benchmarks/word2vec_profile.py): a pass spawned by
+    # a watcher whose round is over must die HERE, before burning up to
+    # three 180s tunnel probes and the 1-core host, not at its first
+    # _persist_partial
+    if _round_is_stale():
+        _log("spawning watcher's round is over; stale bench pass "
+             "aborting at startup")
+        raise SystemExit(3)
     quick = "--quick" in sys.argv
     # --fill: gap-filling mode for the tunnel watcher — skip legs that
     # already have a measured (non-error) row in BENCH_PARTIAL.json so a

@@ -28,6 +28,24 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# imported at process start so bench._START_TS captures THIS process's
+# birth time — the stale-round guards compare it to the watcher's
+# .bench_round_start marker
+import bench as _bench_harness
+
+# fast-abort guard: a zombie watcher from a previous round retries this
+# profile 3x per re-arm with a 1800s timeout each — it must die HERE, at
+# process start, not after burning 30 min of the 1-core host per attempt.
+# The catch is the spawner-identity signal (BENCH_WATCH_ROUND env vs the
+# current marker mtime): a fresh child's own birth time is always newer
+# than the marker, so only the inherited identity can expose a zombie
+# spawner. (The write-time guard below still covers a round boundary
+# that happens mid-profile.)
+if _bench_harness._round_is_stale():
+    print("round marker is newer than this process; stale-round w2v "
+          "profile aborting at startup", file=sys.stderr)
+    raise SystemExit(3)
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -107,6 +125,15 @@ def main(vocab=50_000, dim=128, batch=2048, k=5):
             f"NOT scatter-bound ({res['scatter_fraction']:.0%} of the "
             "step): the pallas scatter-add kernel is ruled out by "
             "measurement; gathers+math dominate and already ride XLA")
+    # stale-round guard (same second-line defense as bench._persist_partial):
+    # a profile child that survived a round-boundary plain kill must not
+    # re-create the NEW round's W2V_PROFILE.json from old-round code — the
+    # watcher's [ ! -f ] gate would then skip profiling and declare the
+    # capture complete on a stale artifact
+    if _bench_harness._round_is_stale():
+        print("round marker is newer than this process; refusing to write "
+              "stale W2V_PROFILE.json", file=sys.stderr)
+        raise SystemExit(3)
     # atomic write: a timeout kill mid-dump must not leave a truncated
     # artifact that the watcher's existence check would count as success
     with open("W2V_PROFILE.json.tmp", "w") as f:

@@ -7,16 +7,90 @@
 # filling the gaps until BENCH_PARTIAL.json is clean. bench.py merges
 # per-leg results across passes, so each contact window only has to add
 # the legs still missing.
-# BENCH_WATCH_DIR / BENCH_WATCH_AXON_SITE exist so the state machine can
-# run under the shell-harness test (tests/test_bench_watch_sh.py) with a
-# stub repo + stub jax; production uses the defaults
+# Round-5 lessons (VERDICT r4 weak #3 + ADVICE #1):
+#   - pass caps are per-CONTACT-WINDOW, not per-lifetime: a flapping
+#     tunnel must not burn the whole budget on five 3-minute windows and
+#     leave the rest of the round unwatched. Counters reset on every
+#     down->up transition and after every slow re-arm sleep.
+#   - the watcher NEVER exits. Complete capture degrades to an idle
+#     re-verify loop; cap exhaustion degrades to a slow re-arm. The only
+#     way to stop it is the pidfile group kill below.
+#   - the watcher runs as its own process-group leader (self-setsid), and
+#     the pidfile kill is `kill -- -$(cat .bench_watch.pid)`: a plain kill
+#     of the shell left an in-flight `python bench.py` child alive to
+#     re-pollute the next round's artifact.
+# BENCH_WATCH_DIR / BENCH_WATCH_AXON_SITE / BENCH_WATCH_POLL /
+# BENCH_WATCH_REARM exist so the state machine can run under the
+# shell-harness test (tests/test_bench_watch_sh.py) with a stub repo +
+# stub jax + sub-second sleeps; production uses the defaults
+# absolute self-path BEFORE any cd: a relative $0 would resolve against
+# the post-cd directory and the re-exec below would die at startup
+SELF="$(cd "$(dirname "$0")" && pwd)/$(basename "$0")"
 cd "${BENCH_WATCH_DIR:-/root/repo}" || exit 1
-# pidfile so restarts can kill the exact process (grep/pkill patterns
-# match the restarting shell's own args and kill the wrong process)
+# self-setsid: become a process-group leader so `kill -- -PID` takes down
+# any in-flight bench.py/w2v child with the watcher (ADVICE r4 #1)
+if [ -z "$BENCH_WATCH_NO_SETSID" ] \
+   && [ "$(ps -o pgid= -p $$ | tr -d ' ')" != "$$" ] \
+   && command -v setsid > /dev/null; then
+  exec setsid bash "$SELF" "$@"
+fi
+# pidfile so restarts can kill the exact process group (grep/pkill
+# patterns match the restarting shell's own args and kill the wrong
+# process). Before claiming it: take over from a live incumbent — under
+# the never-exit contract a duplicate watcher would otherwise run
+# forever, double-loading the 1-core host and racing on the artifacts,
+# with its pid lost the moment we overwrite the file. The /proc cmdline
+# check keeps a recycled pid (now some unrelated process) safe from the
+# takeover kill.
+if [ -f .bench_watch.pid ]; then
+  old="$(cat .bench_watch.pid)"
+  if [ -n "$old" ] && [ "$old" != "$$" ] \
+     && grep -aq bench_watch "/proc/$old/cmdline" 2>/dev/null; then
+    echo "$(date -Is) killing incumbent watcher pid $old (group) before takeover" >> bench_watch.log
+    # a LEGACY incumbent (pre-setsid, or setsid-less host) is not a group
+    # leader: the group kills below no-op on it, and a plain kill of the
+    # shell would orphan an in-flight bench.py (and ITS --only children)
+    # to keep racing us on BENCH_PARTIAL.json for up to an hour — collect
+    # two generations of descendants BEFORE the TERM (afterwards they
+    # reparent to init and become unfindable without forbidden pgrep)
+    kids="$(ps -o pid= --ppid "$old" 2>/dev/null)"
+    for k in $kids; do
+      kids="$kids $(ps -o pid= --ppid "$k" 2>/dev/null)"
+    done
+    kill -TERM -- "-$old" 2>/dev/null || kill -TERM "$old" 2>/dev/null
+    for k in $kids; do kill -TERM "$k" 2>/dev/null; done
+    sleep 2
+    # identity re-checks before EVERY -9: the 2s window is enough for a
+    # killed process to exit and its pid to be recycled to an innocent
+    # process — possibly even a new group leader (the TERMs above were
+    # identity-gated; the KILLs must be too). An incumbent the TERM
+    # already reaped simply skips this; surviving kids are handled below.
+    if grep -aq bench_watch "/proc/$old/cmdline" 2>/dev/null; then
+      kill -KILL -- "-$old" 2>/dev/null || kill -KILL "$old" 2>/dev/null
+    fi
+    for k in $kids; do
+      # a kid still parented to the incumbent is certainly ours; one
+      # reparented to init must ALSO look like something the watcher
+      # spawns (bench.py / the probe / the w2v profile) before -9
+      pp="$(ps -o ppid= -p "$k" 2>/dev/null | tr -d ' ')"
+      if [ "$pp" = "$old" ] || { [ "$pp" = "1" ] \
+           && grep -aq -e bench -e word2vec "/proc/$k/cmdline" 2>/dev/null; }; then
+        kill -KILL "$k" 2>/dev/null
+      fi
+    done
+  fi
+fi
 echo $$ > .bench_watch.pid
 # axon plugin registration needs /root/.axon_site on PYTHONPATH (CLAUDE.md);
 # without it jax silently falls back to CPU and the probe would loop forever
 export PYTHONPATH="$PWD:${BENCH_WATCH_AXON_SITE-/root/.axon_site}${PYTHONPATH:+:$PYTHONPATH}"
+# persistent XLA compile cache (VERDICT r4 #2b): a compile paid in one
+# 3-minute tunnel window is FREE in the next. jax reads this env var
+# directly, so every child — bench legs, subprocess-isolated legs, the
+# w2v profile — inherits it with no per-script wiring.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/.jax_compile_cache}"
+POLL="${BENCH_WATCH_POLL:-300}"
+REARM="${BENCH_WATCH_REARM:-3600}"
 PROBE='
 import threading, sys
 res = {}
@@ -36,6 +110,35 @@ if "err" in res:
 sys.exit(0 if "ok" in res else 1)
 '
 log() { echo "$(date -Is) $*" >> bench_watch.log; }
+# single probe path for BOTH the main poll and the re-arm wait: timeout /
+# stderr routing tweaks must apply to every detection site at once
+probe() { timeout 180 python -c "$PROBE" 2>>bench_watch.log; }
+# single promotion rule for BOTH pass kinds: run bench, promote stdout to
+# the artifact only when the pass FINISHED (exit 0) with output — a
+# killed/crashed/stale-aborted pass must not replace the last good
+# artifact with emptiness or a truncated JSON line
+run_pass() {  # run_pass <artifact> <bench flags...>
+  local art="$1"; shift
+  python bench.py "$@" > "$art.tmp" 2>> bench_watch.log
+  local rc=$?
+  log "bench pass ($*) exit=$rc"
+  if [ "$rc" -eq 0 ] && [ -s "$art.tmp" ]; then
+    mv -f "$art.tmp" "$art"
+  fi
+  rm -f "$art.tmp"
+  return "$rc"
+}
+reset_caps() { quick_passes=0; full_passes=0; w2v_attempts=0; }
+# one evaluation of both artifact states, shared by the idle branch and
+# the quick/full gates; pass /dev/null to keep the pre-probe check from
+# appending gap listings to bench_watch.log every outage poll cycle
+compute_state() {  # compute_state [gap-listing sink]
+  local out="${1:-bench_watch.log}"
+  is_clean=1
+  python scripts/bench_state.py BENCH_PARTIAL.json >> "$out" 2>&1 || is_clean=0
+  watch_clean=1
+  python scripts/bench_state.py BENCH_WATCH.json >> "$out" 2>&1 || watch_clean=0
+}
 
 # Round-start artifact hygiene: the merged artifacts must not carry a
 # PRIOR round's rows into this round's proof (a stale-but-clean
@@ -58,29 +161,71 @@ if [ ! -f .bench_round_start ]; then
   done
 fi
 
-full_passes=0
-quick_passes=0
-w2v_attempts=0
+# Round identity for children: the marker's mtime at THIS watcher's
+# start. A zombie watcher surviving a round boundary spawns children
+# whose env carries the OLD identity; bench.py/_round_is_stale compares
+# it to the CURRENT marker mtime and aborts at process start — the
+# birth-time check alone can't catch this (a freshly spawned child is
+# always younger than the marker). Exported ONLY when stat succeeds: a
+# bogus fallback id would doom every child to the stale-abort path for
+# the whole round.
+round_id="$(stat -c %Y .bench_round_start 2>/dev/null)"
+[ -n "$round_id" ] && export BENCH_WATCH_ROUND="$round_id"
+
+reset_caps
+was_down=1
 while true; do
-  if ! timeout 180 python -c "$PROBE" 2>>bench_watch.log; then
+  # Completeness FIRST, probe second: once the capture is complete there
+  # is nothing a live tunnel could trigger, so the idle loop must not
+  # burn a heavyweight jax probe (up to 180s on a dead tunnel) every
+  # cycle on the 1-core host. W2V_PROFILE.json is the LAST gap a window
+  # fills, so its absence (the dominant state while the tunnel is down)
+  # proves incompleteness with a free [ -f ]. When it IS present but the
+  # capture is still incomplete (full-length cap exhausted), the two
+  # ~100ms bench_state spawns recur per outage cycle — accepted: they're
+  # noise next to the 150s dead-tunnel probe they sit in front of, and
+  # their gap listings go to /dev/null here, not the log.
+  is_clean=-1; watch_clean=-1
+  if [ -f W2V_PROFILE.json ]; then
+    compute_state /dev/null
+    if [ "$is_clean" -eq 1 ] && [ "$watch_clean" -eq 1 ]; then
+      log "capture complete; idling ${REARM}s (no probe needed; watcher stays alive)"
+      sleep "$REARM"
+      continue
+    fi
+  fi
+  if ! probe; then
     # short windows are real (03:47 contact lasted ~3 min): poll fast
     # enough that one can't fall entirely inside a sleep (a dead-tunnel
     # probe itself burns up to 180s, so the full cycle is ~8 min)
-    log "tunnel down; sleeping 300s"
-    sleep 300
+    was_down=1
+    log "tunnel down; sleeping ${POLL}s"
+    sleep "$POLL"
     continue
   fi
-  if [ "$quick_passes" -lt 5 ] && ! python scripts/bench_state.py BENCH_PARTIAL.json >> bench_watch.log 2>&1; then
+  if [ "$was_down" -eq 1 ]; then
+    # new contact window: the caps exist to stop a deterministically
+    # failing leg from looping one window forever, NOT to ration the
+    # round — reset them so every fresh contact gets the full budget
+    reset_caps
+    log "tunnel contact: new window, pass counters reset"
+  fi
+  was_down=0
+  if [ "$is_clean" -lt 0 ]; then
+    # tunnel is alive and the pre-probe short-circuit skipped the state
+    # spawns — the gates below need real values
+    compute_state
+  fi
+  if [ "$quick_passes" -lt 5 ] && [ "$is_clean" -eq 0 ]; then
     # --quick until every leg has a measured row: a short window must
     # yield a COMPLETE (if reduced-step) 5-config artifact before any
     # full-length pass hogs the tunnel.
     # --fill re-runs only the legs still missing a measured row; capped
-    # at 5 so one deterministically-failing quick leg can't loop the
-    # watcher forever and never reach the full bench
+    # at 5 per contact window so one deterministically-failing quick leg
+    # can't loop the window forever and never reach the full bench
     log "tunnel ALIVE -> quick pass $((quick_passes + 1)) (filling gaps)"
     touch .quick_pass_start
-    python bench.py --quick --fill > BENCH_WATCH_QUICK.json 2>> bench_watch.log
-    log "quick pass exit=$?"
+    run_pass BENCH_WATCH_QUICK.json --quick --fill
     quick_passes=$((quick_passes + 1))
     # snapshot iff THIS pass updated the artifact (mtime check): a
     # startup failure must not relabel a prior pass's data as quick
@@ -90,22 +235,23 @@ while true; do
     rm -f .quick_pass_start
     continue  # re-probe, re-check state before going full-length
   fi
-  if [ "$full_passes" -lt 3 ] && ! python scripts/bench_state.py BENCH_WATCH.json >> bench_watch.log 2>&1; then
+  if [ "$full_passes" -lt 3 ] && [ "$watch_clean" -eq 0 ]; then
     # Quick artifact is clean; upgrade to full-length numbers. Cap at 3
-    # attempts so a leg that legitimately fails at full length can't
-    # hold the tunnel forever (the merged quick rows remain the record).
+    # per contact window so a leg that legitimately fails at full length
+    # can't hold the tunnel forever (the merged quick rows remain the
+    # record).
     log "-> full bench (attempt $((full_passes + 1)))"
     # --fill at full length: skips rows already measured FULL-length,
     # re-measures rows that only have --quick numbers
-    python bench.py --fill > BENCH_WATCH.json 2>> bench_watch.log
-    log "full bench exit=$?"
+    run_pass BENCH_WATCH.json --fill
     full_passes=$((full_passes + 1))
     continue
   fi
-  # Complete capture: run the word2vec device profile (VERDICT r03 #5,
-  # open since round 1) while the tunnel is still warm, then stop. The
-  # script writes W2V_PROFILE.json itself — stdout goes to a scratch
-  # file, NOT the artifact (two fds on one path garble it).
+  # Quick+full artifacts are as good as this window allows: run the
+  # word2vec device profile (VERDICT r03 #5, open since round 1) while
+  # the tunnel is still warm. The script writes W2V_PROFILE.json itself —
+  # stdout goes to a scratch file, NOT the artifact (two fds on one path
+  # garble it).
   if [ ! -f W2V_PROFILE.json ] && [ "$w2v_attempts" -lt 3 ]; then
     log "-> word2vec device profile (attempt $((w2v_attempts + 1)))"
     w2v_attempts=$((w2v_attempts + 1))
@@ -117,10 +263,38 @@ while true; do
       continue  # back to the probe — the tunnel may have died mid-profile
     fi
   fi
-  if [ -f W2V_PROFILE.json ]; then
-    log "capture complete (full_passes=$full_passes quick=$quick_passes w2v=$w2v_attempts); watcher exiting"
+  # Terminal state of THIS window — but never of the watcher (VERDICT r4
+  # weak #3: exiting left the rest of the round unwatched). Either the
+  # capture JUST completed this iteration (the w2v write above was the
+  # last gap — the top-of-loop idle branch takes over from here on) or
+  # this window's caps are exhausted on something deterministic (slow
+  # re-arm with fresh caps — an hourly retry is cheap and a changed
+  # tunnel/chip state may unstick the leg).
+  # "capture complete" requires the FULL-length artifact too: quick-only
+  # rows satisfying BENCH_PARTIAL must not masquerade as a finished
+  # capture when all 3 full-length attempts failed (that state is an
+  # exhausted window, reported honestly below)
+  if [ "$is_clean" -eq 1 ] && [ "$watch_clean" -eq 1 ] && [ -f W2V_PROFILE.json ]; then
+    log "capture complete (full=$full_passes quick=$quick_passes w2v=$w2v_attempts); idling ${REARM}s (watcher stays alive)"
+    sleep "$REARM"
   else
-    log "capture ended WITHOUT w2v profile ($w2v_attempts attempts exhausted); watcher exiting"
+    log "window caps exhausted with incomplete artifact (full=$full_passes quick=$quick_passes w2v=$w2v_attempts); slow re-arm in ${REARM}s"
+    # Chunked re-arm wait: an uninterruptible hour-long sleep could eat
+    # an entire short contact window (round-4's was ~3 min). Wake every
+    # POLL, probe, and end the wait the moment the tunnel DROPS — the
+    # main loop's fast poll then catches the next revival, which gets a
+    # fresh budget. Only a tunnel that stays up (the deterministic-
+    # failure case this cooldown exists to ration) waits out the REARM.
+    waited=0
+    while [ "$waited" -lt "$REARM" ]; do
+      sleep "$POLL"
+      waited=$((waited + POLL))
+      if ! probe; then
+        was_down=1
+        log "tunnel dropped during re-arm wait; resuming fast poll"
+        break
+      fi
+    done
   fi
-  break
+  reset_caps
 done

@@ -3,25 +3,49 @@
 scripts/bench_watch.sh is the round's critical capture machine, but its
 quick->full->w2v path has never run live (the tunnel never stayed up).
 This harness runs the ACTUAL script in a stub repo: a permissive fake
-`jax` makes the probe succeed instantly, a stub `bench.py` plays
+`jax` makes the probe succeed instantly (or fail while a TUNNEL_DOWN
+marker exists, so outages can be scripted), a stub `bench.py` plays
 scripted scenarios into the real artifact files, and the REAL
 scripts/bench_state.py checker arbitrates completeness — so the shell
-logic (gap-filling loop, caps, artifact-based w2v retry, honest exit
-lines) is what's under test, not stand-ins for it."""
+logic (gap-filling loop, per-contact-window caps, artifact-based w2v
+retry, the never-exit re-arm contract) is what's under test, not
+stand-ins for it.
+
+Round-5 contract (VERDICT r4 weak #3): the watcher NEVER exits — a
+complete capture idles and re-verifies; exhausted caps slow-re-arm with
+fresh counters; every down->up transition resets the counters. Tests
+therefore poll the log for state transitions and kill the watcher's
+process group when done (the group kill itself is part of the contract:
+ADVICE r4 #1 — the self-setsid must make `kill -- -pid` take children
+down too)."""
 import json
 import os
 import shutil
+import signal
 import stat
 import subprocess
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 FAKE_JAX = '''
 """Permissive jax stub: the watcher's PROBE only needs devices()[0]
 .platform != 'cpu' and a summable ones((2,)); sitecustomize (if any)
-touching other attributes gets inert callables."""
+touching other attributes gets inert callables. A TUNNEL_DOWN marker in
+the stub repo root turns the device into a CPU fallback so tests can
+script outages."""
+import os as _os
+
+_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+
+
 class _Dev:
-    platform = "stub-tpu"
+    @property
+    def platform(self):
+        if _os.path.exists(_os.path.join(_ROOT, "TUNNEL_DOWN")):
+            return "cpu"
+        return "stub-tpu"
+
     def __repr__(self):
         return "StubTPU"
 
@@ -41,10 +65,11 @@ def __getattr__(name):
 
 # The stub bench plays a scenario from BENCH_STUB file: each line is one
 # planned invocation outcome ("clean" = every leg measured, "fail:<leg>"
-# = that leg errored this pass). It writes the real artifact shapes the
-# watcher + bench_state consume. The `if False` block carries literal
-# run("...") lines so the REAL bench_state.expected_legs() regex derives
-# the leg list from this stub, exactly as it does from the real bench.py.
+# = that leg errored this pass); the last line repeats forever. It writes
+# the real artifact shapes the watcher + bench_state consume. The
+# `if False` block carries literal run("...") lines so the REAL
+# bench_state.expected_legs() regex derives the leg list from this stub,
+# exactly as it does from the real bench.py.
 FAKE_BENCH = '''
 import json, os, sys
 
@@ -68,9 +93,21 @@ try:
     legs = json.load(open("BENCH_PARTIAL.json")).get("legs", {})
 except Exception:
     pass
+out = {}
 for leg in LEGS:
     if step == f"fail:{leg}":
-        legs[leg] = {"error": "scripted failure"}
+        # mirror the real merge semantics (_persist_partial): an error
+        # row ANNOTATES a measured row, never clobbers it — but the
+        # pass's own stdout (what the watcher redirects into
+        # BENCH_WATCH*.json) carries the error row
+        out[leg] = {"error": "scripted failure"}
+        cur = legs.get(leg)
+        if isinstance(cur, dict) and "error" not in cur:
+            cur = dict(cur)
+            cur["last_error"] = "scripted failure"
+            legs[leg] = cur
+        else:
+            legs[leg] = out[leg]
     else:
         cur = legs.get(leg)
         # mirror the real --fill semantics: re-measure missing/errored
@@ -79,8 +116,9 @@ for leg in LEGS:
                  or (not quick and cur.get("quick")))
         if stale:
             legs[leg] = {"value": 1.0, "quick": quick}
+        out[leg] = legs[leg]
 json.dump({"updated": "t", "legs": legs}, open("BENCH_PARTIAL.json", "w"))
-print(json.dumps({"metric": "stub", "value": 1.0, "extras": legs}))
+print(json.dumps({"metric": "stub", "value": 1.0, "extras": out}))
 '''
 
 FAKE_W2V = '''
@@ -94,7 +132,7 @@ print("{}")
 '''
 
 
-def _mk_harness(tmp_path, plan, env_extra=None):
+def _mk_harness(tmp_path, plan, env_extra=None, tunnel_down=False):
     d = tmp_path / "repo"
     (d / "scripts").mkdir(parents=True)
     (d / "benchmarks").mkdir()
@@ -109,6 +147,8 @@ def _mk_harness(tmp_path, plan, env_extra=None):
     (d / "bench.py").write_text(FAKE_BENCH)
     (d / "benchmarks" / "word2vec_profile.py").write_text(FAKE_W2V)
     (d / "BENCH_STUB").write_text("\n".join(plan))
+    if tunnel_down:
+        (d / "TUNNEL_DOWN").write_text("")
     shutil.copy(os.path.join(REPO, "scripts", "bench_state.py"),
                 d / "scripts" / "bench_state.py")
     script = d / "scripts" / "bench_watch.sh"
@@ -118,63 +158,267 @@ def _mk_harness(tmp_path, plan, env_extra=None):
     env.pop("PYTHONPATH", None)
     env["BENCH_WATCH_DIR"] = str(d)
     env["BENCH_WATCH_AXON_SITE"] = str(d)  # no axon sitecustomize
+    # short (integer — the chunked re-arm wait uses shell arithmetic)
+    # sleeps: the state machine under test is the same; only the waits
+    # shrink
+    env["BENCH_WATCH_POLL"] = "1"
+    env["BENCH_WATCH_REARM"] = "2"
     env.update(env_extra or {})
     return d, env
 
 
-def _run(d, env, timeout=120):
-    r = subprocess.run(["bash", str(d / "scripts" / "bench_watch.sh")],
-                       env=env, capture_output=True, text=True,
-                       timeout=timeout, cwd=str(d))
-    log = (d / "bench_watch.log").read_text()
-    return r, log
+def _spawn(d, env):
+    return subprocess.Popen(
+        ["bash", str(d / "scripts" / "bench_watch.sh")],
+        env=env, cwd=str(d),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
 
 
-def test_happy_path_quick_full_w2v(tmp_path):
+def _log(d) -> str:
+    try:
+        return (d / "bench_watch.log").read_text()
+    except OSError:
+        return ""
+
+
+def _wait_log(d, predicate, timeout=90, what=""):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        log = _log(d)
+        if predicate(log):
+            return log
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}; log:\n{_log(d)[-3000:]}")
+
+
+def _kill(proc, d):
+    """Group kill via the pidfile — the production stop recipe."""
+    try:
+        pid = int((d / ".bench_watch.pid").read_text())
+        os.killpg(pid, signal.SIGKILL)
+    except (OSError, ValueError, ProcessLookupError):
+        pass
+    try:
+        proc.kill()
+    except OSError:
+        pass
+    proc.wait(timeout=10)
+
+
+def test_happy_path_quick_full_w2v_then_idle(tmp_path):
     d, env = _mk_harness(tmp_path, ["clean"])
-    r, log = _run(d, env)
-    assert r.returncode == 0, r.stderr[-500:]
-    assert "quick pass 1" in log
-    assert "-> full bench (attempt 1)" in log
-    assert "word2vec device profile (attempt 1)" in log
-    assert "capture complete" in log
-    # artifacts: merged partial clean, full result captured, w2v present
-    legs = json.load(open(d / "BENCH_PARTIAL.json"))["legs"]
-    assert all("error" not in legs[k] for k in ("leg_a", "leg_b", "leg_c"))
-    assert json.load(open(d / "BENCH_WATCH.json"))["metric"] == "stub"
-    assert (d / "W2V_PROFILE.json").exists()
-    assert (d / "BENCH_PARTIAL_QUICK.json").exists()
-    # quick rows were re-measured at full length before the full check
-    assert not legs["leg_a"].get("quick", False)
-    # one quick + exactly one full pass sufficed (no wasted re-runs)
-    calls = open(d / "BENCH_STUB_COUNT").read()
-    assert calls.count("q") == 1 and calls.count("F") == 1, calls
+    proc = _spawn(d, env)
+    try:
+        log = _wait_log(d, lambda l: "capture complete" in l,
+                        what="capture complete")
+        assert "quick pass 1" in log
+        assert "-> full bench (attempt 1)" in log
+        assert "word2vec device profile (attempt 1)" in log
+        # artifacts: merged partial clean, full result captured, w2v present
+        legs = json.load(open(d / "BENCH_PARTIAL.json"))["legs"]
+        assert all("error" not in legs[k] for k in ("leg_a", "leg_b", "leg_c"))
+        assert json.load(open(d / "BENCH_WATCH.json"))["metric"] == "stub"
+        assert (d / "W2V_PROFILE.json").exists()
+        assert (d / "BENCH_PARTIAL_QUICK.json").exists()
+        # quick rows were re-measured at full length before the full check
+        assert not legs["leg_a"].get("quick", False)
+        # one quick + exactly one full pass sufficed (no wasted re-runs)
+        calls = open(d / "BENCH_STUB_COUNT").read()
+        assert calls.count("q") == 1 and calls.count("F") == 1, calls
+        # NEVER-exit contract: completion idles, it does not exit
+        _wait_log(d, lambda l: l.count("capture complete") >= 2,
+                  what="second idle re-verify")
+        assert proc.poll() is None, "watcher exited after capture"
+        # self-setsid made the watcher a process-group leader, so the
+        # pidfile group kill can reap in-flight children (ADVICE r4 #1).
+        # Only asserted where setsid exists — the script's documented
+        # fallback is to run without leadership on hosts lacking it.
+        if shutil.which("setsid"):
+            pid = int((d / ".bench_watch.pid").read_text())
+            pgid = subprocess.run(["ps", "-o", "pgid=", "-p", str(pid)],
+                                  capture_output=True, text=True).stdout.strip()
+            assert pgid == str(pid), \
+                f"watcher is not its own group leader ({pgid})"
+    finally:
+        _kill(proc, d)
 
 
 def test_failed_leg_retries_then_completes(tmp_path):
     # pass 1 (quick): leg_b errors -> watcher must loop a SECOND quick
     # pass that fills the gap, then proceed full -> w2v -> complete
     d, env = _mk_harness(tmp_path, ["fail:leg_b", "clean"])
-    r, log = _run(d, env)
-    assert r.returncode == 0, r.stderr[-500:]
-    assert "quick pass 1" in log and "quick pass 2" in log
-    assert "capture complete" in log
-    legs = json.load(open(d / "BENCH_PARTIAL.json"))["legs"]
-    assert "error" not in legs["leg_b"]
-    # the failing pass annotated, never clobbered, once measured
-    calls = open(d / "BENCH_STUB_COUNT").read()
-    assert calls.count("q") == 2 and calls.count("F") >= 1
+    proc = _spawn(d, env)
+    try:
+        log = _wait_log(d, lambda l: "capture complete" in l,
+                        what="capture complete")
+        assert "quick pass 1" in log and "quick pass 2" in log
+        legs = json.load(open(d / "BENCH_PARTIAL.json"))["legs"]
+        assert "error" not in legs["leg_b"]
+        # the failing pass annotated, never clobbered, once measured
+        calls = open(d / "BENCH_STUB_COUNT").read()
+        assert calls.count("q") == 2 and calls.count("F") >= 1
+    finally:
+        _kill(proc, d)
 
 
 def test_w2v_retry_on_missing_artifact(tmp_path):
     # w2v attempt 1 exits 0-adjacent (scripted rc=1, no artifact):
-    # the watcher must re-arm and attempt again, then exit complete
+    # the watcher must re-arm and attempt again, then reach complete
     d, env = _mk_harness(tmp_path, ["clean"],
                          env_extra={"W2V_FAIL_FIRST": "1"})
-    r, log = _run(d, env)
-    assert r.returncode == 0, r.stderr[-500:]
-    assert "word2vec device profile (attempt 1)" in log
-    assert "w2v profile failed; re-arming" in log
-    assert "word2vec device profile (attempt 2)" in log
-    assert "capture complete" in log
-    assert (d / "W2V_PROFILE.json").exists()
+    proc = _spawn(d, env)
+    try:
+        log = _wait_log(d, lambda l: "capture complete" in l,
+                        what="capture complete")
+        assert "word2vec device profile (attempt 1)" in log
+        assert "w2v profile failed; re-arming" in log
+        assert "word2vec device profile (attempt 2)" in log
+        assert (d / "W2V_PROFILE.json").exists()
+    finally:
+        _kill(proc, d)
+
+
+def test_cap_exhaustion_slow_rearms_instead_of_exiting(tmp_path):
+    # VERDICT r4 weak #3: leg_b fails DETERMINISTICALLY. One contact
+    # window burns its 5 quick + 3 full passes, then the watcher must
+    # slow-re-arm with fresh counters and keep trying — never exit.
+    d, env = _mk_harness(tmp_path, ["fail:leg_b"])
+    proc = _spawn(d, env)
+    try:
+        log = _wait_log(
+            d, lambda l: l.count("window caps exhausted") >= 2,
+            what="two slow re-arms")
+        # counters were reset between the windows: quick pass 1 ran again
+        assert log.count("quick pass 1 ") >= 2, log[-2000:]
+        assert proc.poll() is None, "watcher exited on cap exhaustion"
+        calls = open(d / "BENCH_STUB_COUNT").read()
+        # per-window budget honored (5 quick / 3 full per window), and a
+        # second window actually spent a fresh budget
+        assert calls.count("q") >= 10 and calls.count("F") >= 6, calls
+    finally:
+        _kill(proc, d)
+
+
+def test_quick_only_capture_is_not_complete(tmp_path):
+    # Quick rows fill BENCH_PARTIAL (clean), but every FULL-length pass
+    # fails: the terminal state must be the honest "caps exhausted", not
+    # "capture complete" — reduced-step --quick numbers are not a
+    # finished capture (the full artifact check gates the done-signal).
+    d, env = _mk_harness(tmp_path, ["clean", "fail:leg_b"])
+    proc = _spawn(d, env)
+    try:
+        log = _wait_log(d, lambda l: "window caps exhausted" in l,
+                        what="exhausted window")
+        assert "capture complete" not in log
+        # the quick row survived the failing full passes (annotate, not
+        # clobber) and records what went wrong
+        legs = json.load(open(d / "BENCH_PARTIAL.json"))["legs"]
+        assert "error" not in legs["leg_b"] and legs["leg_b"]["quick"]
+        assert legs["leg_b"]["last_error"] == "scripted failure"
+        calls = open(d / "BENCH_STUB_COUNT").read()
+        # full cap honored (>= because a follow-up re-armed window may
+        # already be spending its own budget by the time we read this)
+        assert calls.count("F") >= 3 and calls.count("q") == 1, calls
+    finally:
+        _kill(proc, d)
+
+
+def test_startup_takes_over_live_incumbent(tmp_path):
+    # A duplicate watcher under the never-exit contract would run forever
+    # (double bench load, artifact races) with its pid lost the moment
+    # the new watcher overwrites the pidfile — startup must kill a live
+    # incumbent named by the pidfile first. The stand-in process carries
+    # "bench_watch" as argv[0] so the /proc cmdline identity check (the
+    # recycled-pid safety) recognizes it.
+    d, env = _mk_harness(tmp_path, ["clean"])
+    dummy = subprocess.Popen(["bash", "-c", "exec -a bench_watch sleep 300"])
+    (d / ".bench_watch.pid").write_text(str(dummy.pid))
+    proc = _spawn(d, env)
+    try:
+        _wait_log(d, lambda l: "killing incumbent watcher" in l,
+                  what="takeover log line")
+        assert dummy.wait(timeout=15) != 0  # incumbent was killed
+        _wait_log(d, lambda l: "capture complete" in l,
+                  what="new watcher proceeds to capture")
+        assert int((d / ".bench_watch.pid").read_text()) != dummy.pid
+    finally:
+        dummy.poll() or dummy.kill()
+        _kill(proc, d)
+
+
+def test_stale_pidfile_of_dead_process_is_ignored(tmp_path):
+    # A dead incumbent (or a recycled pid now naming a non-watcher
+    # process) must NOT trigger the takeover kill.
+    d, env = _mk_harness(tmp_path, ["clean"])
+    innocent = subprocess.Popen(["sleep", "300"])
+    (d / ".bench_watch.pid").write_text(str(innocent.pid))
+    proc = _spawn(d, env)
+    try:
+        _wait_log(d, lambda l: "capture complete" in l, what="capture")
+        assert innocent.poll() is None, "non-watcher process was killed"
+        assert "killing incumbent watcher" not in _log(d)
+    finally:
+        innocent.kill()
+        _kill(proc, d)
+
+
+def test_round_guard_spawner_identity(monkeypatch, tmp_path):
+    # bench._round_is_stale: the spawner-identity signal (BENCH_WATCH_ROUND
+    # exported by the watcher) must catch a zombie spawner even though a
+    # freshly spawned child is always younger than the marker.
+    import sys
+    sys.path.insert(0, REPO)
+    import bench
+
+    marker = tmp_path / ".bench_round_start"
+    marker.write_text("")
+    monkeypatch.setattr(bench, "_ROUND_MARKER", str(marker))
+    monkeypatch.setattr(bench, "_START_TS", time.time())
+    mt = int(os.path.getmtime(str(marker)))
+    # same round id -> not stale (signal 2 also passes: marker older)
+    monkeypatch.setenv("BENCH_WATCH_ROUND", str(mt))
+    assert not bench._round_is_stale()
+    # zombie spawner: inherited id predates the current marker -> stale
+    monkeypatch.setenv("BENCH_WATCH_ROUND", str(mt - 5))
+    assert bench._round_is_stale()
+    # garbled id -> fail safe (stale)
+    monkeypatch.setenv("BENCH_WATCH_ROUND", "not-a-number")
+    assert bench._round_is_stale()
+    # no watcher in the ancestry (manual run) -> signal 2 only
+    monkeypatch.delenv("BENCH_WATCH_ROUND")
+    assert not bench._round_is_stale()
+    monkeypatch.setattr(bench, "_START_TS", mt - 100)
+    assert bench._round_is_stale()
+
+
+def test_flapping_tunnel_resets_counters_per_contact(tmp_path):
+    # Five short windows separated by outages must each get a FRESH pass
+    # budget (per-lifetime caps would leave window 2+ unwatched), and the
+    # watcher must still be polling afterwards.
+    d, env = _mk_harness(tmp_path, ["fail:leg_b"], tunnel_down=True)
+    proc = _spawn(d, env)
+    CONTACT = "tunnel contact: new window, pass counters reset"
+    try:
+        _wait_log(d, lambda l: "tunnel down" in l, what="initial outage")
+        for i in range(1, 6):
+            # strictly-new-event waits: cumulative counts can be inflated
+            # by an extra poll cycle the 1-core host squeezed in, which
+            # would pre-satisfy a later iteration's absolute threshold
+            # and desynchronize the toggle from the watcher's real state
+            base = _log(d)
+            (d / "TUNNEL_DOWN").unlink()
+            _wait_log(d, lambda l: l.count(CONTACT) > base.count(CONTACT),
+                      what=f"contact #{i}")
+            _wait_log(d, lambda l: l.count("quick pass 1 ") >
+                      base.count("quick pass 1 "),
+                      what=f"fresh quick budget in window #{i}")
+            mid = _log(d)
+            (d / "TUNNEL_DOWN").write_text("")
+            _wait_log(d, lambda l: l.count("tunnel down") >
+                      mid.count("tunnel down"),
+                      what=f"outage #{i + 1}")
+        assert proc.poll() is None, "watcher died during flapping windows"
+        assert _log(d).count(CONTACT) >= 5
+    finally:
+        _kill(proc, d)
