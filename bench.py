@@ -719,25 +719,46 @@ rng = np.random.default_rng(0)
 x = rng.random((batch, 32, 32, 3)).astype(np.float32)
 y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
 
-def measure(n_dev):
+def setup(n_dev):
     net = build_resnet50(input_size=32, num_classes=10)
     pw = ParallelWrapper(net, num_devices=n_dev)
-    loss = pw.fit(x, y)  # compile
-    float(loss)
+    float(pw.fit(x, y))  # compile + warm once; reps below are all timed
+    return pw
+
+def timed(pw):
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = pw.fit(x, y)
     float(loss)  # host readback: sound completion fence
     return batch * steps / (time.perf_counter() - t0)
 
-# best-of-2 per config, INTERLEAVED 1,8,1,8: on this shared 1-core host a
-# single rep's ratio swings 0.61-0.82 with background load (round-4
-# measurement); interleaving means a load burst must span both configs to
-# bias the ratio, and max() drops the rep it landed on
-t1, t8 = measure(1), measure(8)
-t1, t8 = max(t1, measure(1)), max(t8, measure(8))
-print(json.dumps({"throughput_1dev": round(t1, 2), "throughput_8dev": round(t8, 2),
-                  "dp_overhead_ratio": round(t8 / t1, 4)}))
+# INTERLEAVED paired reps (1,8),(1,8),(1,8): on this shared 1-core host a
+# single rep's ratio swings 0.61-0.83 with background load (round-4
+# measurement); interleaving means a load burst must span both halves of
+# a pair to bias that pair's ratio. The committed number is the MEDIAN
+# pair ratio, and the row carries every rep + the spread so the reader
+# sees the noise floor instead of mistaking one draw for a stable
+# measurement (VERDICT r4 weak #5).
+pw1, pw8 = setup(1), setup(8)
+t1s, t8s, ratios = [], [], []
+for _ in range(3):
+    a, b = timed(pw1), timed(pw8)
+    t1s.append(a); t8s.append(b); ratios.append(b / a)
+# the committed throughputs are the MEDIAN PAIR'S OWN halves, so the row
+# is internally consistent: throughput_8dev / throughput_1dev equals
+# dp_overhead_ratio exactly (mixing max-of-reps throughputs with a
+# median ratio would let the quoted numbers disagree with each other)
+mi = sorted(range(3), key=lambda i: ratios[i])[1]
+print(json.dumps({
+    "throughput_1dev": round(t1s[mi], 2),
+    "throughput_8dev": round(t8s[mi], 2),
+    "dp_overhead_ratio": round(ratios[mi], 4),
+    "ratio_reps": [round(r, 4) for r in ratios],
+    "ratio_spread": round(max(ratios) - min(ratios), 4),
+    "reps": 3,
+    "ratio_stat": "median of 3 interleaved pair ratios; throughputs are "
+                  "the median pair's own halves",
+}))
 """
 
 

@@ -65,8 +65,21 @@ class BertConfig:
 
     @property
     def mask_id(self) -> int:
-        return (self.mask_token_id if self.mask_token_id is not None
-                else self.vocab_size - 1)
+        if self.mask_token_id is None:
+            # audible, not silent (ADVICE r4): if the caller's vocab does
+            # NOT reserve the top slot, the rarest real token doubles as
+            # [MASK] and corrupts the MLM objective with no other signal.
+            # warnings' default filter dedupes per call site, so the fit
+            # loop isn't spammed.
+            import warnings
+
+            warnings.warn(
+                "BertConfig.mask_token_id not set: defaulting [MASK] to "
+                f"vocab_size-1 = {self.vocab_size - 1}. Make sure the "
+                "vocab reserves that slot (examples/bert_mlm.py does), "
+                "or pass the real mask id.", stacklevel=2)
+            return self.vocab_size - 1
+        return self.mask_token_id
 
 
 def init_params(cfg: BertConfig) -> Params:
@@ -396,11 +409,19 @@ class BertMLM:
         return float(losses[-1])
 
     def masked_accuracy(self, tokens, n_draws: int = 1) -> float:
-        """Fraction of masked positions predicted exactly (argmax)."""
+        """Fraction of masked positions predicted exactly (argmax).
+
+        Draws masks from a DEDICATED eval RNG: consuming the training
+        stream (self._rng) here would make every subsequent fit() step's
+        dynamic masking depend on the eval cadence — two runs with
+        identical fit sequences but different eval calls would train on
+        different data (ADVICE r4). Re-seeded per call, so the estimate
+        is also deterministic for a given (seed, n_draws)."""
+        eval_rng = np.random.default_rng((self.cfg.seed, 0xE7A1))
         hits = total = 0
         for _ in range(n_draws):
             inputs, targets, weights = mask_tokens(tokens, self.cfg,
-                                                   self._rng)
+                                                   eval_rng)
             logits = self._logits(self.params,
                                   jnp.asarray(inputs, jnp.int32))
             pred = np.asarray(jnp.argmax(logits, axis=-1))

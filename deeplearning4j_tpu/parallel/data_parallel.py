@@ -122,11 +122,17 @@ class ParallelWrapper:
 
     def _check_divisible(self, b: int) -> None:
         # multi-process runs feed the PROCESS-LOCAL shard (multihost
-        # .put_batch), so the divisibility bar is the local device share
+        # .put_batch), so the divisibility bar is the local device share —
+        # counted from the mesh itself, not self.n // process_count():
+        # a mesh over a device subset, or devices spread unevenly across
+        # processes, would make the quotient wrong in both directions
+        # (ADVICE r4)
         n = self.n
         pc = jax.process_count()
         if pc > 1:
-            n = max(1, n // pc)
+            pi = jax.process_index()
+            n = max(1, sum(1 for d in self.mesh.devices.flat
+                           if d.process_index == pi))
         if b % n != 0:
             raise ValueError(
                 f"batch {b} not divisible by {n} "

@@ -19,6 +19,7 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 # The axon sitecustomize imports jax at interpreter startup with
 # JAX_PLATFORMS=axon already in the env, so the env vars above are too late
@@ -27,3 +28,41 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+
+# ---------------------------------------------------------------------------
+# Test tiers (VERDICT r4 #7): `-m quick` is the ~2-minute gate — the
+# highest-value correctness tests (closed-form updater math, weight-init
+# stats, conf round-trip, MLP/CNN gradient checks, MultiLayerNetwork core
+# equivalences, the bench/watcher capture machinery) — so changes can be
+# validated without the ~38-minute full suite colliding with a live
+# tunnel window on this 1-core host. The full suite remains the bar;
+# quick is triage.
+# ---------------------------------------------------------------------------
+
+_QUICK_FILES = {
+    "test_updaters.py",
+    "test_weight_init.py",
+    "test_conf_serde.py",
+    "test_kernel_gate.py",
+    "test_bench_artifact.py",
+    "test_bench_preflight.py",
+    "test_bench_watch_sh.py",
+    "test_gradient_check.py",
+    "test_multilayer.py",
+}
+# float64 recurrent gradchecks cost ~2 min alone — full-suite only
+_QUICK_EXCLUDE = {"test_rnn_masked_gradients", "test_lstm_gradients",
+                  "test_gru_gradients"}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: fast high-value gate (see CLAUDE.md test tiers)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = os.path.basename(str(item.fspath))
+        if (base in _QUICK_FILES
+                and item.name.split("[")[0] not in _QUICK_EXCLUDE):
+            item.add_marker(pytest.mark.quick)
