@@ -89,7 +89,11 @@ export PYTHONPATH="$PWD:${BENCH_WATCH_AXON_SITE-/root/.axon_site}${PYTHONPATH:+:
 # directly, so every child — bench legs, subprocess-isolated legs, the
 # w2v profile — inherits it with no per-script wiring.
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/root/.jax_compile_cache}"
-POLL="${BENCH_WATCH_POLL:-300}"
+# 150s sleep + ~150s dead-probe hang ≈ 5-min detection cycle (was 8 min
+# at 300s): a ~3-minute contact window (round-4's norm) is marginal at
+# 8 but catchable at 5. CPU cost per cycle is only the ~10-15s jax
+# import — the 150s hang itself is ~0 CPU.
+POLL="${BENCH_WATCH_POLL:-150}"
 REARM="${BENCH_WATCH_REARM:-3600}"
 PROBE='
 import threading, sys
@@ -197,7 +201,7 @@ while true; do
   if ! probe; then
     # short windows are real (03:47 contact lasted ~3 min): poll fast
     # enough that one can't fall entirely inside a sleep (a dead-tunnel
-    # probe itself burns up to 180s, so the full cycle is ~8 min)
+    # probe itself burns ~150s, so the full cycle is ~5 min)
     was_down=1
     log "tunnel down; sleeping ${POLL}s"
     sleep "$POLL"
