@@ -28,10 +28,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# imported at process start so bench._START_TS captures THIS process's
-# birth time — the stale-round guards compare it to the watcher's
-# .bench_round_start marker
-import bench as _bench_harness
+# imported at process start so round_guard.START_TS captures THIS
+# process's birth time — the stale-round guards compare it to the
+# watcher's .bench_round_start marker. round_guard (not bench!) so this
+# profiler stops inheriting bench's import-time env mutations (ADVICE r5).
+import round_guard as _round_guard
 
 # fast-abort guard: a zombie watcher from a previous round retries this
 # profile 3x per re-arm with a 1800s timeout each — it must die HERE, at
@@ -41,7 +42,7 @@ import bench as _bench_harness
 # than the marker, so only the inherited identity can expose a zombie
 # spawner. (The write-time guard below still covers a round boundary
 # that happens mid-profile.)
-if _bench_harness._round_is_stale():
+if _round_guard.round_is_stale():
     print("round marker is newer than this process; stale-round w2v "
           "profile aborting at startup", file=sys.stderr)
     raise SystemExit(3)
@@ -130,7 +131,7 @@ def main(vocab=50_000, dim=128, batch=2048, k=5):
     # re-create the NEW round's W2V_PROFILE.json from old-round code — the
     # watcher's [ ! -f ] gate would then skip profiling and declare the
     # capture complete on a stale artifact
-    if _bench_harness._round_is_stale():
+    if _round_guard.round_is_stale():
         print("round marker is newer than this process; refusing to write "
               "stale W2V_PROFILE.json", file=sys.stderr)
         raise SystemExit(3)

@@ -444,14 +444,17 @@ def _donation_kwargs():
     pattern passes one initial params tree to several step functions
     (tests, dryrun legs), which donation would poison on real chips.
     Optimizer state is always built fresh per run (init_opt_state), so its
-    donation is safe by construction. CPU platforms skip donation (jax
-    ignores it there with a warning per compile). The decision reads the
-    jax_platforms CONFIG, never the backend — jax.default_backend() would
-    initialize the axon plugin at factory-construction time, which hangs
-    on a dead tunnel (CLAUDE.md) and locks the platform before the caller
-    could still choose CPU."""
-    platforms = jax.config.jax_platforms
-    if platforms and platforms.split(",")[0] == "cpu":
+    donation is safe by construction.
+
+    The on/off decision is the shared policy in ops/dispatch
+    (donation_enabled: CPU platforms skip donation, the DL4J_TPU_DONATE
+    env knob overrides both ways; the check reads the jax_platforms CONFIG,
+    never the backend — jax.default_backend() would initialize the axon
+    plugin at factory-construction time, which hangs on a dead tunnel,
+    CLAUDE.md)."""
+    from deeplearning4j_tpu.ops import dispatch
+
+    if not dispatch.donation_enabled():
         return {}
     return {"donate_argnums": (1,)}
 
@@ -879,8 +882,12 @@ def pipeline_forward(params: Params, tokens: jax.Array,
                 h, a = _moe_block(bp, h, cfg, cdt=cdt)
                 return (h, aux + a), None
 
+            # aux carried as [1]: a rank-0 float scan carry becomes a
+            # rank-0 shard_map residual, which this jax's (0.4.x)
+            # shard_map transpose mis-specs (see
+            # parallel/pipeline_parallel._pipeline_body)
             (h, aux), _ = lax.scan(
-                block, (h, jnp.zeros((), jnp.float32)), sp)
+                block, (h, jnp.zeros((1,), jnp.float32)), sp)
             return h, aux
     else:
         def stage_fn(sp, h):

@@ -46,7 +46,7 @@ from deeplearning4j_tpu.nn.layers.factory import (
     create_layer,
 )
 from deeplearning4j_tpu.nn.layers.feedforward import OutputLayerImpl
-from deeplearning4j_tpu.ops import rng as rng_mod
+from deeplearning4j_tpu.ops import dispatch, rng as rng_mod
 from deeplearning4j_tpu.optimize.updaters import LayerUpdater, apply_updates
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -87,6 +87,14 @@ class ComputationGraph:
         self._rng = rng_mod.key(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
         self._input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None
+        self.dispatch_stats = dispatch.DispatchStats()
+        # see MultiLayerNetwork: BN batch statistics would absorb pad rows
+        self._bucketing_blocked = any(
+            isinstance(v, conf_layers.BatchNormalization)
+            for v in conf.vertices.values()
+        )
+        # True while fit_iterator drives fit() — bucketing's "auto" scope
+        self._bucket_scope = False
 
     # ------------------------------------------------------------------ init
     def _infer_input_shapes(self) -> Dict[str, Tuple[int, ...]]:
@@ -423,7 +431,11 @@ class ComputationGraph:
             params = apply_updates(params, updates, self.conf.minimize)
             return params, new_states, upd_state, loss
 
-        fn = jax.jit(train_step)
+        # donation contract as in MultiLayerNetwork._get_train_step: every
+        # caller re-binds params/states/upd_state from the returned triple
+        fn = dispatch.instrumented_jit(
+            train_step, "train_step", self.dispatch_stats,
+            donate=(0, 1, 2), step=True)
         self._jit_cache[key] = fn
         return fn
 
@@ -468,7 +480,9 @@ class ComputationGraph:
             )
             return params, states, upd_state, losses.reshape(-1)
 
-        fn = jax.jit(scan_fn)
+        fn = dispatch.instrumented_jit(
+            scan_fn, "fit_batches", self.dispatch_stats,
+            donate=(0, 1, 2), step=True)
         self._jit_cache[key] = fn
         return fn
 
@@ -550,6 +564,9 @@ class ComputationGraph:
             from deeplearning4j_tpu.optimize.solvers import Solver
 
             return Solver(self).optimize_graph(inputs, labels_l, masks_d, lmasks)
+        inputs, labels_l, masks_d, lmasks = self._bucket_batch(
+            inputs, labels_l, masks_d, lmasks
+        )
         step = self._get_train_step(len(labels_l), lmasks is not None)
         loss = None
         for _ in range(max(1, self.conf.iterations)):
@@ -567,6 +584,53 @@ class ComputationGraph:
             )
             self._record_iteration(loss)
         return loss
+
+    def _bucket_batch(self, inputs, labels_l, masks_d, lmasks):
+        """Shape bucketing for the DAG container (see
+        MultiLayerNetwork._bucket_batch): every input/label/mask is padded
+        along the example axis up to dispatch.bucket_size, and each output
+        gets a label mask that zeroes the pad rows out of its loss.
+
+        Skipped when feature masks are present without a full set of
+        explicit label masks: such outputs take their loss mask from
+        _loss's mask PROPAGATION, and whether the propagated mask reaches a
+        given output is a graph property this hook cannot cheaply verify —
+        an unmasked padded output would divide by the padded row count.
+        (The MLN container has no such ambiguity: its single output always
+        falls back to the feature mask directly.)"""
+        mode = dispatch.bucketing_mode()
+        if (mode == "off" or (mode == "auto" and not self._bucket_scope)
+                or self._bucketing_blocked):
+            return inputs, labels_l, masks_d, lmasks
+        explicit = (lmasks is not None
+                    and all(m is not None for m in lmasks))
+        if masks_d and not explicit:
+            return inputs, labels_l, masks_d, lmasks
+        n = next(iter(inputs.values())).shape[0]
+        target = dispatch.bucket_size(n)
+        if target != n:
+            ik, mk = list(inputs), list(masks_d)
+            padded = dispatch.pad_rows(
+                self.dispatch_stats, target,
+                [inputs[k] for k in ik] + labels_l + [masks_d[k] for k in mk],
+            )
+            inputs = dict(zip(ik, padded[:len(ik)]))
+            labels_l = padded[len(ik):len(ik) + len(labels_l)]
+            masks_d = dict(zip(mk, padded[len(ik) + len(labels_l):]))
+        new_lmasks = []
+        for oi, labels in enumerate(labels_l):
+            lm = lmasks[oi] if lmasks is not None else None
+            if lm is not None:
+                lm = dispatch.pad_axis0(lm, target)
+            else:
+                # row-validity mask: all-ones for an exact-bucket batch, so
+                # every bucket shares one jit signature (see MLN hook)
+                lm = dispatch.row_validity_mask(
+                    n, target,
+                    labels.shape[1] if labels.ndim == 3 else None,
+                )
+            new_lmasks.append(lm)
+        return inputs, labels_l, masks_d, new_lmasks
 
     def _reset_rnn_states(self, batch_n: int) -> None:
         """Zero recurrent state sized for this batch (sequence start — the
@@ -671,20 +735,25 @@ class ComputationGraph:
                  == "stochastic_gradient_descent")
         from deeplearning4j_tpu.nn.common import fused_iterator_loop
 
-        for _ in range(num_epochs):
-            if not fused:
-                for ds in iterator:
-                    self._fit_ds(ds)
-            else:
-                fused_iterator_loop(
-                    iterator, fused_batches,
-                    can_stack=self._graph_stackable,  # fit_batches: no masks
-                    same_shape=self._same_shapes,
-                    fit_one=self._fit_ds,
-                    fit_fused=self._fit_fused_graph,
-                )
-            if hasattr(iterator, "reset"):
-                iterator.reset()
+        # bucketing's "auto" scope (see MultiLayerNetwork.fit_iterator)
+        self._bucket_scope = True
+        try:
+            for _ in range(num_epochs):
+                if not fused:
+                    for ds in iterator:
+                        self._fit_ds(ds)
+                else:
+                    fused_iterator_loop(
+                        iterator, fused_batches,
+                        can_stack=self._graph_stackable,  # fit_batches: no masks
+                        same_shape=self._same_shapes,
+                        fit_one=self._fit_ds,
+                        fit_fused=self._fit_fused_graph,
+                    )
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+        finally:
+            self._bucket_scope = False
         return self
 
     @staticmethod
@@ -735,17 +804,27 @@ class ComputationGraph:
                 acts, _ = self._forward(params, states, inputs, train=False)
                 return [acts[o] for o in self.conf.outputs]
 
-            self._jit_cache[key] = jax.jit(out_fn)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                out_fn, "output", self.dispatch_stats)
         return self._jit_cache[key]
 
     def output(self, *features) -> List[jax.Array]:
         """Inference outputs in conf.outputs order (reference output()/
-        feedForward)."""
+        feedForward). Ragged batches are bucket-padded and sliced back —
+        inference-mode padding is unconditionally safe (BN running stats,
+        no dropout), so arbitrary batch sizes compile O(log n) programs."""
         if self.params is None:
             self.init()
         if len(features) == 1 and isinstance(features[0], (list, tuple)):
             features = tuple(features[0])
         inputs = self._as_inputs(list(features))
+        n = next(iter(inputs.values())).shape[0]
+        target = dispatch.inference_bucket(self.dispatch_stats, n)
+        if target is not None:
+            inputs = {k: dispatch.pad_axis0(v, target)
+                      for k, v in inputs.items()}
+            outs = self._get_output_fn()(self.params, self.states, inputs)
+            return [o[:n] for o in outs]
         return self._get_output_fn()(self.params, self.states, inputs)
 
     def feed_forward(self, *features) -> Dict[str, jax.Array]:
@@ -786,7 +865,8 @@ class ComputationGraph:
                 )
                 return loss
 
-            self._jit_cache[key] = jax.jit(score_fn)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                score_fn, "score", self.dispatch_stats)
         return self._jit_cache[key]
 
     def score(self, features, labels, masks=None, label_masks=None) -> float:
@@ -869,7 +949,8 @@ class ComputationGraph:
                     o[:, -1, :] if o.ndim == 3 else o for o in outs
                 ], new_states
 
-            self._jit_cache[key] = jax.jit(step_fn)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                step_fn, "rnn_step", self.dispatch_stats)
         outs, self.states = self._jit_cache[key](
             self.params, self.states, inputs
         )
@@ -894,10 +975,12 @@ class ComputationGraph:
     def clone(self) -> "ComputationGraph":
         other = ComputationGraph(self.conf)
         if self.params is not None:
-            other.params = jax.tree_util.tree_map(lambda x: x, self.params)
-            other.states = jax.tree_util.tree_map(lambda x: x, self.states)
+            # real copies (not leaf-sharing): donation would delete shared
+            # leaves on the original's next train step
+            other.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            other.states = jax.tree_util.tree_map(jnp.copy, self.states)
             other.updater_state = jax.tree_util.tree_map(
-                lambda x: x, self.updater_state
+                jnp.copy, self.updater_state
             )
             other._input_shapes = dict(self._input_shapes or {})
         other.iteration = self.iteration

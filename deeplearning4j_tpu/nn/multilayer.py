@@ -46,7 +46,7 @@ from deeplearning4j_tpu.nn.layers.feedforward import (
     OutputLayerImpl,
     RBMImpl,
 )
-from deeplearning4j_tpu.ops import rng as rng_mod
+from deeplearning4j_tpu.ops import dispatch, rng as rng_mod
 from deeplearning4j_tpu.optimize.updaters import MultiLayerUpdater, apply_updates
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -70,6 +70,17 @@ class MultiLayerNetwork:
         self._rng = rng_mod.key(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
         self._input_shape: Optional[Tuple[int, ...]] = None
+        self.dispatch_stats = dispatch.DispatchStats()
+        # batch-statistics layers make shape bucketing unsound in training:
+        # the pad rows would enter the BN batch mean/var (loss masking
+        # cannot undo that), so fit() skips bucketing for these nets
+        self._bucketing_blocked = any(
+            isinstance(lc, conf_layers.BatchNormalization)
+            for lc in conf.layers
+        )
+        # True while fit_iterator drives fit(): the scope where bucketing's
+        # "auto" mode applies (dispatch.bucketing_mode)
+        self._bucket_scope = False
 
     # ------------------------------------------------------------------ init
     def _infer_input_shape(self) -> Tuple[int, ...]:
@@ -278,7 +289,14 @@ class MultiLayerNetwork:
             params = apply_updates(params, updates, self.conf.minimize)
             return params, new_states, upd_state, loss
 
-        fn = jax.jit(train_step)
+        # params/states/upd_state are donated: every caller (fit,
+        # _fit_tbptt, ParallelWrapper) re-binds them from the returned
+        # triple, so the superseded buffers are never re-read and the
+        # update happens in-place in HBM instead of copying the whole
+        # training state each step
+        fn = dispatch.instrumented_jit(
+            train_step, "train_step", self.dispatch_stats,
+            donate=(0, 1, 2), step=True)
         self._jit_cache[key] = fn
         return fn
 
@@ -290,7 +308,8 @@ class MultiLayerNetwork:
                 acts, _ = self._forward(params, states, x, train=False)
                 return acts[-1]
 
-            self._jit_cache[key] = jax.jit(out_fn)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                out_fn, "output", self.dispatch_stats)
         return self._jit_cache[key]
 
     def _get_score_fn(self, has_mask: bool, has_label_mask: bool):
@@ -310,7 +329,8 @@ class MultiLayerNetwork:
                 )
                 return loss
 
-            self._jit_cache[key] = jax.jit(score_fn)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                score_fn, "score", self.dispatch_stats)
         return self._jit_cache[key]
 
     # ------------------------------------------------------------------- fit
@@ -344,6 +364,9 @@ class MultiLayerNetwork:
             from deeplearning4j_tpu.optimize.solvers import Solver
 
             return Solver(self).optimize(features, labels, mask, label_mask)
+        features, labels, mask, label_mask = self._bucket_batch(
+            features, labels, mask, label_mask
+        )
         step = self._get_train_step(mask is not None, label_mask is not None)
         loss = None
         for _ in range(max(1, self.conf.iterations)):
@@ -361,6 +384,46 @@ class MultiLayerNetwork:
             )
             self._record_iteration(loss)
         return loss
+
+    def _bucket_batch(self, features, labels, mask, label_mask):
+        """Shape bucketing (dispatch.bucket_size): pad a ragged batch up to
+        its bucket and mask the pad rows out of the loss, so fit() compiles
+        once per BUCKET instead of once per batch shape. The reference's
+        fit(DataSet) (MultiLayerNetwork.java:1017) accepts arbitrary shapes
+        because a JVM re-dispatch is cheap; here every new shape is a full
+        XLA retrace of the whole-step program.
+
+        The row-validity mask rides the existing label-mask plumbing
+        (nn/losses._masked_mean_per_example divides by the mask sum), which
+        makes the padding semantically free; it is attached even when no
+        padding happened so a padded 100-batch and an exact 128-batch share
+        ONE jit signature. Applies per dispatch.bucketing_mode — by default
+        only inside fit_iterator's loop (direct fit() stays byte-exact for
+        the equivalence contracts). Skipped for BatchNormalization nets
+        (pad rows would enter the batch statistics) and for the
+        TBPTT/Solver paths, which dispatch before this hook."""
+        mode = dispatch.bucketing_mode()
+        if (mode == "off" or (mode == "auto" and not self._bucket_scope)
+                or self._bucketing_blocked):
+            return features, labels, mask, label_mask
+        n = features.shape[0]
+        target = dispatch.bucket_size(n)
+        if target != n:
+            features, labels, mask, label_mask = dispatch.pad_rows(
+                self.dispatch_stats, target,
+                [features, labels, mask, label_mask],
+            )
+        if label_mask is None:
+            # the same fallback _loss applies (lmask = label_mask or mask),
+            # made explicit so the padded and unpadded signatures agree;
+            # pad rows of a padded feature mask are already all-zero
+            label_mask = mask if mask is not None else (
+                dispatch.row_validity_mask(
+                    n, target,
+                    labels.shape[1] if labels.ndim == 3 else None,
+                )
+            )
+        return features, labels, mask, label_mask
 
     def _get_fit_batches_fn(self, has_mask: bool, has_label_mask: bool):
         """K train steps fused into ONE lax.scan — the reference's
@@ -416,7 +479,11 @@ class MultiLayerNetwork:
             )
             return params, states, upd_state, losses.reshape(-1)
 
-        fn = jax.jit(scan_fn)
+        # same donation contract as the train step: fit_batches re-binds
+        # params/states/upd_state from the scan's outputs
+        fn = dispatch.instrumented_jit(
+            scan_fn, "fit_batches", self.dispatch_stats,
+            donate=(0, 1, 2), step=True)
         self._jit_cache[key] = fn
         return fn
 
@@ -554,20 +621,27 @@ class MultiLayerNetwork:
 
         fit_one = lambda ds: self.fit(ds.features, ds.labels,
                                       ds.features_mask, ds.labels_mask)
-        for _ in range(num_epochs):
-            if not fused:
-                for ds in iterator:
-                    fit_one(ds)
-            else:
-                fused_iterator_loop(
-                    iterator, fused_batches,
-                    can_stack=lambda ds: True,  # fit_batches stacks masks
-                    same_shape=self._stackable,
-                    fit_one=fit_one,
-                    fit_fused=self._fit_fused,
-                )
-            if hasattr(iterator, "reset"):
-                iterator.reset()
+        # the iterator loop is bucketing's "auto" scope: ragged tails and
+        # shape drift land here, and each one costs a full XLA retrace
+        # unless padded up to a bucket (dispatch.bucketing_mode)
+        self._bucket_scope = True
+        try:
+            for _ in range(num_epochs):
+                if not fused:
+                    for ds in iterator:
+                        fit_one(ds)
+                else:
+                    fused_iterator_loop(
+                        iterator, fused_batches,
+                        can_stack=lambda ds: True,  # fit_batches stacks masks
+                        same_shape=self._stackable,
+                        fit_one=fit_one,
+                        fit_fused=self._fit_fused,
+                    )
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+        finally:
+            self._bucket_scope = False
         return self
 
     @staticmethod
@@ -626,12 +700,18 @@ class MultiLayerNetwork:
                     g = jax.grad(lambda pp: layer.pretrain_loss(pp, x, k))(p)
                     return g, None
 
-            @jax.jit
-            def pretrain_step(p, s, x, it, k):
+            def _pretrain_step(p, s, x, it, k):
                 g, _ = grads_fn(p, x, k)
                 upd, s = lu.update(g, s, p, it)
                 p = apply_updates(p, upd, True)
                 return p, s
+
+            # donated: self.params[i] and lu_state are re-bound from the
+            # returned pair each call; earlier layers' params (read by the
+            # inference forward above) are not arguments here
+            pretrain_step = dispatch.instrumented_jit(
+                _pretrain_step, "pretrain_step", self.dispatch_stats,
+                donate=(0, 1), step=True)
 
             it_count = 0
             for _ in range(num_epochs):
@@ -662,9 +742,18 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- inference
     def output(self, x) -> jax.Array:
-        """Batch inference (reference output(INDArray) :619-704)."""
+        """Batch inference (reference output(INDArray) :619-704). Ragged
+        batches are bucket-padded and sliced back — inference-mode padding
+        is unconditionally safe (BN uses running stats, dropout is off), so
+        a stream of arbitrary batch sizes compiles O(log n) programs."""
         fn = self._get_output_fn()
-        return fn(self.params, self.states, jnp.asarray(x))
+        x = jnp.asarray(x)
+        n = x.shape[0]
+        target = dispatch.inference_bucket(self.dispatch_stats, n)
+        if target is not None:
+            return fn(self.params, self.states,
+                      dispatch.pad_axis0(x, target))[:n]
+        return fn(self.params, self.states, x)
 
     def feed_forward(self, x, train: bool = False):
         """All layer activations (reference feedForward(train)). train=True
@@ -735,7 +824,8 @@ class MultiLayerNetwork:
         the step is one compiled XLA program)."""
         key = ("rnn_step",)
         if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(self._rnn_step_body)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                self._rnn_step_body, "rnn_step", self.dispatch_stats)
         return self._jit_cache[key]
 
     def _get_rnn_seq_fn(self):
@@ -752,7 +842,8 @@ class MultiLayerNetwork:
                 states, ys = jax.lax.scan(body, states, jnp.swapaxes(x, 0, 1))
                 return jnp.swapaxes(ys, 0, 1), states
 
-            self._jit_cache[key] = jax.jit(seq_fn)
+            self._jit_cache[key] = dispatch.instrumented_jit(
+                seq_fn, "rnn_seq", self.dispatch_stats)
         return self._jit_cache[key]
 
     def _rnn_step_body(self, params, states, x):
@@ -802,10 +893,13 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         if self.params is not None:
             net._input_shape = self._input_shape
-            net.params = jax.tree_util.tree_map(lambda a: a, self.params)
-            net.states = jax.tree_util.tree_map(lambda a: a, self.states)
+            # REAL copies, not leaf-sharing (tree_map identity): under
+            # buffer donation the original's next train step would delete
+            # shared leaves out from under the clone
+            net.params = jax.tree_util.tree_map(jnp.copy, self.params)
+            net.states = jax.tree_util.tree_map(jnp.copy, self.states)
             net.updater_state = jax.tree_util.tree_map(
-                lambda a: a, self.updater_state
+                jnp.copy, self.updater_state
             )
             net.iteration = self.iteration
         return net

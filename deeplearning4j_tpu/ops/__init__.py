@@ -10,3 +10,4 @@ op factory: deeplearning4j-core/.../nn/layers/BaseLayer.java:369-372).
 
 from deeplearning4j_tpu.ops.dtypes import DtypePolicy, get_policy, set_policy, float32_strict
 from deeplearning4j_tpu.ops.activations import activation, ACTIVATIONS
+from deeplearning4j_tpu.ops.dispatch import DispatchStats

@@ -1,0 +1,360 @@
+"""Dispatch-efficiency layer: buffer donation, shape bucketing, persistent
+compile cache, and retrace/dispatch telemetry.
+
+The reference's training entry points accept arbitrary batch shapes
+(``MultiLayerNetwork.fit(DataSet)`` — MultiLayerNetwork.java:1017) and pay a
+per-op JVM dispatch cost; under jax the cost model shifts but does not
+vanish: a NEW batch shape is a full XLA retrace of the whole-step program,
+and a jit without donated buffers copies params + optimizer state through
+HBM on every step. On this chip a dispatch costs ~5ms and end-to-end MFU
+tops out ~11% (BENCH_NOTES.md) — compile/dispatch amortization is the
+single biggest lever left. This module concentrates the counter-measures
+the containers (nn/multilayer.py, nn/graph.py), the Solver
+(optimize/solvers.py), the parallel trainers (parallel/data_parallel.py)
+and the flagship factories (models/transformer.py) all share:
+
+  1. donation policy   — ``donation_enabled()`` / ``instrumented_jit(...,
+     donate=...)``: donate ``params/states/upd_state`` into the step so the
+     update is in-place on device. Default ON on accelerators, OFF on CPU
+     (the test/equivalence substrate routinely re-reads params trees — the
+     same rationale as models/transformer._donation_kwargs); the env knob
+     ``DL4J_TPU_DONATE`` overrides both ways ("force" turns it on even on
+     CPU, which this jax implements for real — tests use it to verify the
+     call sites never re-read a donated buffer).
+  2. shape bucketing   — ``bucket_size()`` pads ragged batches up to a
+     small power-of-two-ish set so ``fit``/``fit_iterator``/``output``
+     compile once per BUCKET instead of once per shape; the pad rows are
+     masked out of the loss through the existing mask plumbing
+     (nn/losses._masked_mean_per_example), which makes padding
+     semantically free. Knob: ``DL4J_TPU_BUCKET_BATCHES`` (default on).
+  3. compile cache     — ``enable_compile_cache()`` wires jax's persistent
+     XLA compilation cache (``jax_compilation_cache_dir``) so round
+     restarts and bench subprocess legs warm-start instead of recompiling.
+     Knob: ``DL4J_TPU_COMPILE_CACHE`` (path | "0" to disable; default
+     ``.jax_cache/`` under the cwd; an explicit
+     ``JAX_COMPILATION_CACHE_DIR`` wins — that is jax's own env var, which
+     the bench watcher already exports to every child).
+  4. telemetry         — ``DispatchStats``: per-network counters of traces
+     (XLA compiles), dispatches (calls; calls - traces = compiled-cache
+     hits), donated-vs-copied steps and padded batches, surfaced through
+     the listener chain (optimize/listeners.DispatchStatsListener) and the
+     ``dispatch_overhead`` bench leg.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import defaultdict
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ENV_DONATE = "DL4J_TPU_DONATE"
+ENV_BUCKET = "DL4J_TPU_BUCKET_BATCHES"
+ENV_CACHE = "DL4J_TPU_COMPILE_CACHE"
+
+_OFF = ("0", "off", "false", "no")
+_ON = ("1", "on", "true", "yes", "force")
+
+
+
+# ---------------------------------------------------------------------------
+# donation policy
+# ---------------------------------------------------------------------------
+
+
+def donation_enabled() -> bool:
+    """Should train-step jits donate their params/states/upd_state buffers?
+
+    Read at jit-CONSTRUCTION time (the containers cache jits, so flipping
+    the env after a net has compiled does not retro-actively change it).
+
+    Default: donate on accelerators, skip on CPU — CPU runs are the
+    test/equivalence substrate where callers routinely hold one initial
+    params tree across several step functions (the serial-vs-distributed
+    pattern), which donation would poison. The decision reads the
+    ``jax_platforms`` CONFIG, never ``jax.default_backend()`` — the latter
+    initializes the axon TPU plugin, which hangs on a dead tunnel and locks
+    the platform before the caller could still choose CPU (CLAUDE.md).
+    """
+    v = os.environ.get(ENV_DONATE, "").strip().lower()
+    if v in _OFF:
+        return False
+    if v in _ON:
+        return True
+    platforms = jax.config.jax_platforms
+    return not (platforms and platforms.split(",")[0] == "cpu")
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+
+class DispatchStats:
+    """Per-network dispatch-efficiency counters.
+
+    The reference has nothing like this because its failure mode (per-op
+    dispatch) is uniform; under jax the pathologies are *episodic* (a
+    ragged batch triggering a silent 30s retrace) and need a counter to be
+    visible at all.
+
+      traces[name]   python-level traces of the named jit == XLA compiles
+                     (a retrace on a new shape increments it again)
+      calls[name]    dispatches of the named jit; calls - traces is the
+                     compiled-program cache-hit count
+      donated_steps / copied_steps
+                     steps executed with / without buffer donation
+      padded_batches / padded_examples
+                     shape-bucketing activity (fit calls that padded, and
+                     the total pad rows fed)
+    """
+
+    def __init__(self) -> None:
+        self.traces: Dict[str, int] = defaultdict(int)
+        self.calls: Dict[str, int] = defaultdict(int)
+        self.donated_steps = 0
+        self.copied_steps = 0
+        self.padded_batches = 0
+        self.padded_examples = 0
+
+    def cache_hits(self, name: Optional[str] = None) -> int:
+        if name is not None:
+            return self.calls.get(name, 0) - self.traces.get(name, 0)
+        return sum(self.calls.values()) - sum(self.traces.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "traces": dict(self.traces),
+            "calls": dict(self.calls),
+            "cache_hits": {n: self.cache_hits(n) for n in self.calls},
+            "donated_steps": self.donated_steps,
+            "copied_steps": self.copied_steps,
+            "padded_batches": self.padded_batches,
+            "padded_examples": self.padded_examples,
+        }
+
+
+def instrumented_jit(fn, name: str, stats: DispatchStats, *,
+                     donate: Sequence[int] = (),
+                     static_argnums=None, step: bool = False):
+    """``jax.jit`` with retrace/dispatch telemetry and policy-gated donation.
+
+    ``donate``: argnums to donate WHEN the donation policy is on; the
+    caller guarantees those arguments are re-bound from the return value
+    and never re-read (the containers' ``self.params, ... = step(...)``
+    discipline). Call sites that DO re-read an argument — the Solver's
+    line-search oracle re-probes the same flat param vector — must pass
+    ``donate=()``.
+
+    ``step=True`` marks a training step for the donated/copied counters.
+
+    The returned wrapper exposes ``.lower`` (bench cost-analysis uses it)
+    and ``.donated_argnums`` (tests assert the policy).
+    """
+    enable_compile_cache()
+    donated: Tuple[int, ...] = tuple(donate) if (
+        donate and donation_enabled()) else ()
+    kw: Dict[str, Any] = {}
+    if donated:
+        kw["donate_argnums"] = donated
+    if static_argnums is not None:
+        kw["static_argnums"] = static_argnums
+
+    counting = [True]  # AOT .lower() re-traces for analysis, not dispatch
+
+    def traced(*args, **kwargs):
+        if counting[0]:
+            stats.traces[name] += 1
+        return fn(*args, **kwargs)
+
+    jfn = jax.jit(traced, **kw)
+
+    def wrapper(*args, **kwargs):
+        stats.calls[name] += 1
+        if step:
+            if donated:
+                stats.donated_steps += 1
+            else:
+                stats.copied_steps += 1
+        return jfn(*args, **kwargs)
+
+    def lower(*args, **kwargs):
+        # cost-analysis lowering (bench legs) must not skew the
+        # traces-vs-calls cache-hit arithmetic: it traces without
+        # dispatching, which would read as a phantom retrace
+        counting[0] = False
+        try:
+            return jfn.lower(*args, **kwargs)
+        finally:
+            counting[0] = True
+
+    wrapper.lower = lower
+    wrapper.donated_argnums = donated
+    wrapper._jitted = jfn
+    wrapper.__name__ = f"jit_{name}"
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# shape bucketing
+# ---------------------------------------------------------------------------
+
+
+def bucketing_mode() -> str:
+    """Bucketing policy, read at CALL time (per fit) so tests can toggle.
+
+      "off"    — never pad (DL4J_TPU_BUCKET_BATCHES=0)
+      "always" — every fit() buckets (DL4J_TPU_BUCKET_BATCHES=1)
+      "auto"   — the default: bucket inside fit_iterator (the hot loop
+                 where ragged tails and shape drift actually occur) and in
+                 inference (output), but leave DIRECT fit(features, labels)
+                 calls byte-exact — the repo's equivalence contracts
+                 (fit_batches == K serial fits, distributed == serial)
+                 compare direct-fit trajectories at tight tolerance, and
+                 padding legitimately reassociates float32 reductions and
+                 reshapes dropout draws.
+    """
+    v = os.environ.get(ENV_BUCKET, "").strip().lower()
+    if v in _OFF:
+        return "off"
+    if v in _ON:
+        return "always"
+    return "auto"
+
+
+def bucket_size(n: int) -> int:
+    """Smallest power-of-two-ish size >= n.
+
+    The bucket set is {1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, ...}
+    — powers of two and 1.5x powers of two — so padding waste stays under
+    50% (worst case sits just above a power of two) and a stream of
+    arbitrary batch sizes compiles O(log n) programs instead of one per
+    distinct size (the reference's fit(DataSet) accepts any shape because
+    a JVM op re-dispatch is cheap; an XLA retrace is not)."""
+    if n <= 2:
+        return max(n, 1)
+    p = 1
+    while p < n:
+        p <<= 1
+    mid = (p >> 1) + (p >> 2)  # 1.5 * (p/2), sits between p/2 and p
+    return mid if (p >= 4 and n <= mid) else p
+
+
+def pad_axis0(a, target: int):
+    """Zero-pad axis 0 up to ``target`` rows (no-op when already there)."""
+    a = jnp.asarray(a)
+    if a.shape[0] == target:
+        return a
+    pad = [(0, target - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return jnp.pad(a, pad)
+
+
+def inference_bucket(stats: DispatchStats, n: int) -> Optional[int]:
+    """Inference-side bucketing decision shared by both containers'
+    output(): the padded size to use (recording the activity in
+    ``stats``), or None when no padding applies. Inference padding is
+    unconditionally safe — BN uses running stats and dropout is off — so
+    the only gates are the mode knob and n already being a bucket."""
+    if bucketing_mode() == "off":
+        return None
+    target = bucket_size(n)
+    if target == n:
+        return None
+    stats.padded_batches += 1
+    stats.padded_examples += target - n
+    return target
+
+
+def pad_rows(stats: DispatchStats, target: int, arrays):
+    """Pad each array (None entries pass through) along axis 0 to
+    ``target`` and record the bucketing activity ONCE in ``stats`` — the
+    single home of the pad-and-count discipline both containers' fit hooks
+    share. Call only when padding is actually needed (target > batch)."""
+    n = next(a for a in arrays if a is not None).shape[0]
+    stats.padded_batches += 1
+    stats.padded_examples += target - n
+    return [None if a is None else pad_axis0(a, target) for a in arrays]
+
+
+# memoized host-side masks: the mask is a pure function of
+# (n_real, n_padded, time_steps), and building it eagerly with jnp ops
+# would cost per-fit device dispatches (~5ms each through the remote-TPU
+# tunnel) on the exact hot path this module exists to thin out. A numpy
+# array rides the jit call's normal argument transfer instead.
+_ROW_MASKS: Dict[Tuple[int, int, Optional[int]], "np.ndarray"] = {}
+
+
+def row_validity_mask(n_real: int, n_padded: int,
+                      time_steps: Optional[int] = None):
+    """1.0 for real rows, 0.0 for pad rows — fed as the label mask so the
+    masked-mean loss (nn/losses._masked_mean_per_example) divides by the
+    REAL example count. For an unpadded batch this is all-ones, and
+    sum(loss * 1) / sum(ones) is bit-identical to the plain mean — which is
+    why the containers attach it even when no padding happened: every
+    bucket then shares ONE jit signature instead of splitting into
+    padded/unpadded variants of the same shape."""
+    key = (n_real, n_padded, time_steps)
+    m = _ROW_MASKS.get(key)
+    if m is None:
+        m = (np.arange(n_padded) < n_real).astype(np.float32)
+        if time_steps is not None:
+            m = np.broadcast_to(m[:, None], (n_padded, time_steps))
+        _ROW_MASKS[key] = m
+    return m
+
+
+# ---------------------------------------------------------------------------
+# persistent compile cache
+# ---------------------------------------------------------------------------
+
+_CACHE_LOCK = threading.Lock()
+_CACHE_WIRED: Optional[str] = None
+
+
+def compile_cache_dir() -> Optional[str]:
+    """Resolve the cache directory from the env knobs (None = disabled)."""
+    v = os.environ.get(ENV_CACHE, "").strip()
+    if v.lower() in _OFF:
+        return None
+    if v:
+        return v
+    # jax's own env var: the bench watcher exports it to every child, and
+    # an operator setting it explicitly should win over our default
+    native = os.environ.get("JAX_COMPILATION_CACHE_DIR", "").strip()
+    if native:
+        return native
+    return os.path.join(os.getcwd(), ".jax_cache")
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None,
+                         min_compile_secs: float = 1.0) -> Optional[str]:
+    """Wire jax's persistent XLA compilation cache (idempotent).
+
+    Round restarts and bench subprocess legs re-jit the same programs; with
+    the cache on disk the re-compile is a file read — a compile paid in one
+    tunnel contact window is FREE in the next. Explicit ``cache_dir``
+    always re-wires (tests point it at a tmpdir with
+    ``min_compile_secs=0`` to force tiny compiles into the cache);
+    otherwise the env-resolved directory is wired once per process.
+    Returns the active directory, or None when disabled/unsupported."""
+    global _CACHE_WIRED
+    with _CACHE_LOCK:
+        if os.environ.get(ENV_CACHE, "").strip().lower() in _OFF:
+            return None  # the off-switch beats even an explicit cache_dir
+        d = cache_dir or compile_cache_dir()
+        if d is None:
+            return None
+        if cache_dir is None and _CACHE_WIRED is not None:
+            return _CACHE_WIRED
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_compile_secs))
+        except Exception:  # noqa: BLE001 — cache is an optimization, never a crash
+            return None
+        _CACHE_WIRED = d
+        return d

@@ -44,6 +44,34 @@ class CollectScoresIterationListener(IterationListener):
             self.scores.append((iteration, float(score)))
 
 
+class DispatchStatsListener(IterationListener):
+    """Surface the dispatch-efficiency telemetry (ops/dispatch.DispatchStats
+    — XLA traces, compiled-cache hits, donated-vs-copied steps, bucketing
+    pad counts) through the listener chain every N iterations, the same hook
+    the reference uses for its per-iteration observability
+    (StochasticGradientDescent.java:66-67). A burst of `traces` growth
+    mid-training is the retrace pathology this PR's bucketing exists to
+    kill; this listener is how it becomes visible without a profiler."""
+
+    def __init__(self, frequency: int = 100):
+        self.frequency = max(1, int(frequency))
+        self.snapshots: List[dict] = []
+
+    def iteration_done(self, model, iteration, score):
+        stats = getattr(model, "dispatch_stats", None)
+        if stats is None or iteration % self.frequency != 0:
+            return
+        snap = dict(stats.snapshot(), iteration=iteration)
+        self.snapshots.append(snap)
+        logger.info(
+            "iteration %d dispatch: traces=%s cache_hits=%d donated=%d "
+            "copied=%d padded_batches=%d",
+            iteration, dict(snap["traces"]), sum(snap["cache_hits"].values()),
+            snap["donated_steps"], snap["copied_steps"],
+            snap["padded_batches"],
+        )
+
+
 class PerformanceListener(IterationListener):
     """Throughput tracking (samples/sec) — TPU-side equivalent of the Spark
     stats instrumentation (SURVEY.md section 5 'Tracing/profiling')."""

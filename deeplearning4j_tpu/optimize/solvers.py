@@ -291,7 +291,15 @@ class Solver:
 
     The value-and-grad and value-only oracles are jitted ONCE per network
     (cached in the container's _jit_cache) with data as traced arguments, so
-    new minibatches do NOT recompile."""
+    new minibatches do NOT recompile.
+
+    DONATION GUARD: unlike the containers' train steps, these oracles must
+    NOT donate the flat param vector (ops/dispatch argnum 0) — the
+    line-search family re-reads it by design: backtrack_line_search probes
+    value_fn(x + step*direction) repeatedly while x stays live, and every
+    optimizer re-reads x across iterations. The oracles therefore take the
+    telemetry wrapper with donate=() (traces/dispatches still counted in
+    net.dispatch_stats under 'solver_vg'/'solver_value')."""
 
     def __init__(self, net, algo: Optional[str] = None):
         self.net = net
@@ -315,9 +323,14 @@ class Solver:
                 )
                 return val
 
-            net._jit_cache[key] = (
-                jax.jit(jax.value_and_grad(loss)),
-                jax.jit(loss),
+            from deeplearning4j_tpu.ops import dispatch
+
+            net._jit_cache[key] = (  # no donation — see class docstring
+                dispatch.instrumented_jit(
+                    jax.value_and_grad(loss), "solver_vg",
+                    net.dispatch_stats),
+                dispatch.instrumented_jit(
+                    loss, "solver_value", net.dispatch_stats),
             )
         return net._jit_cache[key]
 
@@ -333,9 +346,14 @@ class Solver:
                 )
                 return val
 
-            net._jit_cache[key] = (
-                jax.jit(jax.value_and_grad(loss)),
-                jax.jit(loss),
+            from deeplearning4j_tpu.ops import dispatch
+
+            net._jit_cache[key] = (  # no donation — see class docstring
+                dispatch.instrumented_jit(
+                    jax.value_and_grad(loss), "solver_vg",
+                    net.dispatch_stats),
+                dispatch.instrumented_jit(
+                    loss, "solver_value", net.dispatch_stats),
             )
         return net._jit_cache[key]
 

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map
 
 from deeplearning4j_tpu.ops import rng as rng_mod
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, device_mesh
@@ -131,8 +131,19 @@ class ParallelWrapper:
         pc = jax.process_count()
         if pc > 1:
             pi = jax.process_index()
-            n = max(1, sum(1 for d in self.mesh.devices.flat
-                           if d.process_index == pi))
+            n = sum(1 for d in self.mesh.devices.flat
+                    if d.process_index == pi)
+            if n == 0:
+                # fail HERE with the real cause — clamping to 1 (the old
+                # max(1, ...)) let any batch pass the divisibility gate and
+                # the failure surfaced later as an opaque
+                # make_array_from_process_local_data error (ADVICE r5)
+                raise ValueError(
+                    f"process {pi} owns none of the mesh's devices: this "
+                    "process cannot feed a data-parallel shard. Build the "
+                    "mesh over devices of every participating process, or "
+                    "exclude this process from the trainer."
+                )
         if b % n != 0:
             raise ValueError(
                 f"batch {b} not divisible by {n} "
@@ -340,7 +351,15 @@ class ParameterAveragingTrainer:
             out_specs=(repl, repl, repl, repl),
             check_vma=False,
         )
-        return jax.jit(fn)
+        # params/states/upd_state donated: fit() re-binds all three from
+        # the averaging round's outputs (the recurrent stream-state leaves
+        # that pass through unaveraged alias input to output, which is
+        # exactly what donation expresses)
+        from deeplearning4j_tpu.ops import dispatch
+
+        return dispatch.instrumented_jit(
+            fn, "param_avg_worker", self.net.dispatch_stats,
+            donate=(0, 1, 2), step=True)
 
     def _build_step(self, has_mask: bool, has_label_mask: bool):
         """MultiLayerNetwork worker (list states, one shared updater)."""

@@ -20,6 +20,30 @@ PIPELINE_AXIS = "pipe"
 SEQUENCE_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
+# --------------------------------------------------------------------------
+# shard_map compatibility: newer jax exports it at top level with a
+# `check_vma` flag; this environment's jax (0.4.x) has it under
+# jax.experimental with the older `check_rep` spelling. Every parallel
+# module imports THIS symbol so the whole stack tracks one shim.
+# --------------------------------------------------------------------------
+try:
+    from jax import shard_map as _jax_shard_map  # jax >= 0.6
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - exercised on the 0.4.x image
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """jax.shard_map with the replication-check flag translated to whatever
+    this jax version calls it (check_vma in new jax, check_rep before)."""
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _jax_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
 
 def device_mesh(
     num_devices: Optional[int] = None,

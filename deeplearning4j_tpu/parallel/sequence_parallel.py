@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.parallel.mesh import shard_map
 
 SEQ_AXIS = "seq"
 

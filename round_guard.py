@@ -1,0 +1,55 @@
+"""Round-boundary staleness guard, shared by bench.py and
+benchmarks/word2vec_profile.py.
+
+Deliberately SIDE-EFFECT-FREE (no env mutation, no jax import): the w2v
+profiler used to import bench just for this check and thereby inherited
+bench's import-time environment setup (os.environ.setdefault et al.) —
+ADVICE r5. The only module-level state captured here is the import
+timestamp, which both scripts take at process start, so it approximates
+the process birth time the staleness signals need.
+
+The guard itself (two signals, see round_is_stale): a bench/profile child
+spawned by a watcher whose round is over — or running across a round
+boundary — must abort rather than write a prior round's rows into the new
+round's artifacts (scripts/bench_watch.sh round hygiene; CLAUDE.md).
+"""
+
+import os
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+# first-import time ~= process birth time (both consumers import this
+# module at the top of the file, before any slow work)
+START_TS = time.time()
+ROUND_MARKER = os.path.join(_REPO_ROOT, ".bench_round_start")
+
+
+def round_is_stale(marker: str = None, start_ts: float = None) -> bool:
+    """True when the current round (the .bench_round_start marker) is newer
+    than this process or than the watcher that spawned it."""
+    if marker is None:
+        marker = ROUND_MARKER
+    if start_ts is None:
+        start_ts = START_TS
+    # Signal 1 — spawner identity: the watcher exports BENCH_WATCH_ROUND
+    # (the marker's mtime at ITS start). A zombie watcher from a prior
+    # round hands its children the OLD identity; any mismatch with the
+    # current marker means the spawning watcher's round is over. This is
+    # the check that catches freshly spawned children (whose own birth
+    # time is always newer than the marker, blinding signal 2).
+    # "0"/empty = no identity (a failed stat at watcher start must not
+    # doom every child of an otherwise healthy watcher to stale-abort)
+    spawner_round = os.environ.get("BENCH_WATCH_ROUND")
+    if spawner_round and spawner_round != "0":
+        try:
+            if int(os.path.getmtime(marker)) != int(spawner_round):
+                return True
+        except (OSError, ValueError):
+            return True  # marker vanished mid-boundary / garbled id
+    # Signal 2 — own birth time: covers a round boundary that happens
+    # WHILE this process is running (marker re-touched after we started).
+    try:
+        return os.path.getmtime(marker) > start_ts
+    except OSError:
+        return False  # no marker yet: round hygiene hasn't run — write ok

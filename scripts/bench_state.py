@@ -20,11 +20,11 @@ import sys
 # fallback only — expected_legs() derives the live list from bench.py's
 # run() calls so a new leg can't silently escape the completeness check
 EXPECTED = [
-    "mxu_calibration", "lenet5", "lenet5_fused", "char_rnn",
-    "word2vec_sgns", "transformer_lm", "resnet50", "resnet50_bf16",
-    "transformer_lm_big", "flash_attention", "ring_attention",
-    "lstm_kernel", "north_star", "reference_cpu_lenet5_torch",
-    "native_feed", "scaling_virtual8",
+    "mxu_calibration", "lenet5", "lenet5_fused", "dispatch_overhead",
+    "char_rnn", "word2vec_sgns", "transformer_lm", "resnet50",
+    "resnet50_bf16", "transformer_lm_big", "flash_attention",
+    "ring_attention", "lstm_kernel", "north_star",
+    "reference_cpu_lenet5_torch", "native_feed", "scaling_virtual8",
 ]
 
 _BENCH_PY = os.path.join(os.path.dirname(os.path.dirname(

@@ -44,8 +44,12 @@ fi
 # takeover kill.
 if [ -f .bench_watch.pid ]; then
   old="$(cat .bench_watch.pid)"
+  # identity grep is the SCRIPT PATH, not the bare 'bench_watch' substring
+  # (ADVICE r5): a recycled pid landing on the restart wrapper shell —
+  # whose argv contains 'bench_watch', the exact pkill trap CLAUDE.md
+  # warns about — must not pass as the incumbent
   if [ -n "$old" ] && [ "$old" != "$$" ] \
-     && grep -aq bench_watch "/proc/$old/cmdline" 2>/dev/null; then
+     && grep -aq scripts/bench_watch.sh "/proc/$old/cmdline" 2>/dev/null; then
     echo "$(date -Is) killing incumbent watcher pid $old (group) before takeover" >> bench_watch.log
     # a LEGACY incumbent (pre-setsid, or setsid-less host) is not a group
     # leader: the group kills below no-op on it, and a plain kill of the
@@ -58,14 +62,23 @@ if [ -f .bench_watch.pid ]; then
       kids="$kids $(ps -o pid= --ppid "$k" 2>/dev/null)"
     done
     kill -TERM -- "-$old" 2>/dev/null || kill -TERM "$old" 2>/dev/null
-    for k in $kids; do kill -TERM "$k" 2>/dev/null; done
+    # per-kid TERMs carry the SAME ppid/cmdline identity gate as the -9s
+    # below (ADVICE r5): a pid collected from ps and recycled in the
+    # interim must not get TERMed just for having been in the list
+    for k in $kids; do
+      pp="$(ps -o ppid= -p "$k" 2>/dev/null | tr -d ' ')"
+      if [ "$pp" = "$old" ] || { [ "$pp" = "1" ] \
+           && grep -aq -e bench -e word2vec "/proc/$k/cmdline" 2>/dev/null; }; then
+        kill -TERM "$k" 2>/dev/null
+      fi
+    done
     sleep 2
     # identity re-checks before EVERY -9: the 2s window is enough for a
     # killed process to exit and its pid to be recycled to an innocent
     # process — possibly even a new group leader (the TERMs above were
     # identity-gated; the KILLs must be too). An incumbent the TERM
     # already reaped simply skips this; surviving kids are handled below.
-    if grep -aq bench_watch "/proc/$old/cmdline" 2>/dev/null; then
+    if grep -aq scripts/bench_watch.sh "/proc/$old/cmdline" 2>/dev/null; then
       kill -KILL -- "-$old" 2>/dev/null || kill -KILL "$old" 2>/dev/null
     fi
     for k in $kids; do

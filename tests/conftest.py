@@ -12,11 +12,14 @@ double precision there, GradientCheckUtil.java).
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell env may point at a TPU
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# REPLACE any inherited device-count flag (an =2 left over from a multihost
+# worker env would otherwise silently win on the 0.4.x image, where the
+# jax_num_cpu_devices fallback below is swallowed) — same discipline as
+# tests/multihost_worker.py and __graft_entry__._set_cpu_device_count
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
@@ -26,7 +29,15 @@ import pytest  # noqa: E402
 # for jax's import-time config read — set the config directly (backends have
 # not initialized yet when conftest runs).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # this environment's jax predates the jax_num_cpu_devices option; the
+    # XLA_FLAGS fallback set above (before first backend use — the flags
+    # are read at CPU-client creation, not jax import) provides the
+    # 8-device virtual mesh instead. Without the try/except the whole
+    # suite dies at collection.
+    pass
 jax.config.update("jax_enable_x64", True)
 
 # ---------------------------------------------------------------------------
@@ -49,6 +60,7 @@ _QUICK_FILES = {
     "test_bench_watch_sh.py",
     "test_gradient_check.py",
     "test_multilayer.py",
+    "test_dispatch.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only
 _QUICK_EXCLUDE = {"test_rnn_masked_gradients", "test_lstm_gradients",
@@ -61,8 +73,26 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(config, items):
+    seen_files = set()
+    seen_names = set()
     for item in items:
         base = os.path.basename(str(item.fspath))
-        if (base in _QUICK_FILES
-                and item.name.split("[")[0] not in _QUICK_EXCLUDE):
-            item.add_marker(pytest.mark.quick)
+        if base in _QUICK_FILES:
+            seen_files.add(base)
+            name = item.name.split("[")[0]
+            seen_names.add(name)
+            if name not in _QUICK_EXCLUDE:
+                item.add_marker(pytest.mark.quick)
+    # Stale-exclusion guard (ADVICE r5): a renamed/removed slow test must
+    # fail collection LOUDLY, not silently re-enter the 2-minute quick
+    # gate. Only enforced when every quick file was collected (a partial
+    # run — one file, a -k filter — legitimately misses names).
+    if seen_files >= _QUICK_FILES:
+        stale = _QUICK_EXCLUDE - seen_names
+        if stale:
+            raise pytest.UsageError(
+                f"_QUICK_EXCLUDE entries never seen in collection: "
+                f"{sorted(stale)} — the excluded tests were renamed or "
+                "removed; update tests/conftest.py so the quick tier "
+                "stays honest"
+            )

@@ -329,10 +329,12 @@ def test_startup_takes_over_live_incumbent(tmp_path):
     # (double bench load, artifact races) with its pid lost the moment
     # the new watcher overwrites the pidfile — startup must kill a live
     # incumbent named by the pidfile first. The stand-in process carries
-    # "bench_watch" as argv[0] so the /proc cmdline identity check (the
-    # recycled-pid safety) recognizes it.
+    # "scripts/bench_watch.sh" in argv[0] so the tightened /proc cmdline
+    # identity check (script path, not the bare substring — ADVICE r5)
+    # recognizes it.
     d, env = _mk_harness(tmp_path, ["clean"])
-    dummy = subprocess.Popen(["bash", "-c", "exec -a bench_watch sleep 300"])
+    dummy = subprocess.Popen(
+        ["bash", "-c", "exec -a scripts/bench_watch.sh sleep 300"])
     (d / ".bench_watch.pid").write_text(str(dummy.pid))
     proc = _spawn(d, env)
     try:
@@ -360,6 +362,25 @@ def test_stale_pidfile_of_dead_process_is_ignored(tmp_path):
         assert "killing incumbent watcher" not in _log(d)
     finally:
         innocent.kill()
+        _kill(proc, d)
+
+
+def test_takeover_ignores_bare_substring_impostor(tmp_path):
+    # The restart wrapper shell's argv contains 'bench_watch' (CLAUDE.md's
+    # pkill trap) but NOT the script path — the tightened identity grep
+    # (scripts/bench_watch.sh, ADVICE r5) must leave a recycled pid that
+    # landed on such a process alone.
+    d, env = _mk_harness(tmp_path, ["clean"])
+    impostor = subprocess.Popen(
+        ["bash", "-c", "exec -a bench_watch sleep 300"])
+    (d / ".bench_watch.pid").write_text(str(impostor.pid))
+    proc = _spawn(d, env)
+    try:
+        _wait_log(d, lambda l: "capture complete" in l, what="capture")
+        assert impostor.poll() is None, "bare-substring impostor was killed"
+        assert "killing incumbent watcher" not in _log(d)
+    finally:
+        impostor.kill()
         _kill(proc, d)
 
 

@@ -122,7 +122,10 @@ class TestTraining:
             batches.append(seq)
         first = lm.fit(batches[0])
         last = None
-        for _ in range(40):
+        # 60 epochs (was 40): the masking-draw rng stream differs across
+        # jax versions and this environment's stream converges a bit
+        # later (measured: acc 0.68 @40, 0.94 @60) — same bar, more steps
+        for _ in range(60):
             for b in batches:
                 last = lm.fit(b)
         assert last < first * 0.5, (first, last)

@@ -49,6 +49,11 @@ def test_two_process_dp_training_matches_serial():
                 q.kill()
             raise
         outs.append((p.returncode, out, err))
+    if any("MH_SKIP" in out for _, out, _ in outs):
+        import pytest
+
+        pytest.skip("this jaxlib cannot run multi-process computations on "
+                    "the CPU backend (worker capability probe)")
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout:{out}\nstderr:{err[-2000:]}"
         assert "MH_OK" in out, out
