@@ -32,7 +32,11 @@ Package layout:
   clustering/    KMeans + KD/Quad/SP/VP trees
   plot/          t-SNE (exact + Barnes-Hut), filter/reconstruction renders
   earlystopping/ terminations, savers, trainers (+ distributed)
-  streaming/     HTTP model serving (predict + generate), record serde
+  serving/       production inference engine: dynamic batching,
+                 continuous LM decode (KV slot pool), model registry
+                 (load/warmup/serve/unload), telemetry at /metrics
+  streaming/     HTTP model serving front-end (predict + generate),
+                 record serde, streaming-training pipeline
   ui/            stdlib HTTP dashboards, SVG chart DSL, listeners
   provision/     TPU pod-slice setup, GCS dataset/artifact IO
   native/        C++ host runtime (idx/CSV/npz parsing, shuffling,

@@ -245,7 +245,10 @@ def _attention(q, k, v, n_heads, use_flash=False):
         jnp.asarray(hd, q.dtype))
     mask = jnp.tril(jnp.ones((t, t), bool))
     s = jnp.where(mask[None, None], s, jnp.asarray(-1e9, s.dtype))
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    from deeplearning4j_tpu.ops.dtypes import softmax_dtype
+
+    p = jax.nn.softmax(s.astype(softmax_dtype(s.dtype)),
+                       axis=-1).astype(q.dtype)
     return jnp.einsum("nhqk,nkhd->nqhd", p, v).reshape(n, t, d)
 
 
@@ -287,9 +290,13 @@ def _moe_ffn(bp, h, cfg: TransformerConfig, capacity: int = 0):
         expert_mlp,
     )
 
+    from deeplearning4j_tpu.ops.dtypes import softmax_dtype
+
     n, t, d = h.shape
     xt = h.reshape(n * t, d)
-    gates = jax.nn.softmax((xt @ bp["Wg"]).astype(jnp.float32), axis=-1)
+    scores = xt @ bp["Wg"]
+    gates = jax.nn.softmax(scores.astype(softmax_dtype(scores.dtype)),
+                           axis=-1)
     if not capacity:
         capacity = max(1, int(cfg.moe_capacity_factor * n * t * cfg.moe_top_k
                               / cfg.moe_experts))
@@ -356,11 +363,15 @@ def nll_loss(logits: jax.Array, targets: jax.Array, mask=None) -> jax.Array:
     losses (dense/pipeline/ring) and evaluate(), so objective and metric
     can never drift. mask ([N, T] 0/1): masked positions excluded from
     numerator AND denominator."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    from deeplearning4j_tpu.ops.dtypes import softmax_dtype
+
+    # at-least-f32 (bf16 logits upcast; f64 stays f64 for the gradchecks)
+    dt = softmax_dtype(logits.dtype)
+    logp = jax.nn.log_softmax(logits.astype(dt), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is None:
         return nll.mean()
-    m = mask.astype(jnp.float32)
+    m = mask.astype(dt)
     return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
 
 

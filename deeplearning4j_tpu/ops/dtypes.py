@@ -53,6 +53,20 @@ def set_policy(policy: DtypePolicy) -> None:
     _policy = policy
 
 
+def softmax_dtype(dtype):
+    """Accumulation dtype for softmax / log-softmax upcasts: AT LEAST
+    float32, never less — and never a DOWNcast.
+
+    The model code's ``astype(float32)`` before attention/loss softmaxes
+    guards bf16 (an exp/sum over thousands of keys loses mass below f32),
+    but a hard cast also demotes float64, which silently quantizes the
+    loss under the x64 gradient-check substrate: a central difference
+    smaller than one f32 ULP of the loss reads back as exactly zero
+    (observed: BERT MLM numeric grads of 0.0 against analytic 1e-4).
+    Promote, don't pin: bf16 -> f32, f32 -> f32, f64 -> f64."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
 @contextlib.contextmanager
 def float32_strict():
     """Context for reference-equivalent numerics (the BASELINE north-star bar)."""

@@ -147,9 +147,14 @@ def _flash_raw(q, k, v, *, causal: bool, interpret: bool):
 
 
 def _dense_reference(q, k, v, *, causal: bool):
-    """XLA dense attention on [B, T, D] (autodiff oracle + fallback)."""
+    """XLA dense attention on [B, T, D] (autodiff oracle + fallback).
+    Softmax upcast is at-least-f32 (ops/dtypes.softmax_dtype): bf16
+    upcasts as before, f64 stays f64 for the gradcheck substrate."""
+    from deeplearning4j_tpu.ops.dtypes import softmax_dtype
+
     d = q.shape[-1]
-    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) / (d ** 0.5)
+    s = jnp.einsum("bqd,bkd->bqk", q, k)
+    s = s.astype(softmax_dtype(s.dtype)) / (d ** 0.5)
     if causal:
         t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
@@ -457,8 +462,11 @@ def flash_attention_masked(q, k, v, key_mask, *, causal: bool = False,
 
 def _dense_masked(q, k, v, key_mask, *, causal: bool):
     """Dense fallback with a key padding mask, [N, T, H, D] layout."""
+    from deeplearning4j_tpu.ops.dtypes import softmax_dtype
+
     d = q.shape[-1]
-    s = jnp.einsum("nqhd,nkhd->nhqk", q, k).astype(jnp.float32) / (d ** 0.5)
+    s = jnp.einsum("nqhd,nkhd->nhqk", q, k)
+    s = s.astype(softmax_dtype(s.dtype)) / (d ** 0.5)
     if causal:
         t = q.shape[1]
         s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s,

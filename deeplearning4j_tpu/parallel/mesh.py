@@ -45,6 +45,34 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
                           out_specs=out_specs, **kwargs)
 
 
+def virtual_cpu_devices(n: int = 8) -> None:
+    """Force a virtual n-device CPU platform BEFORE first backend use —
+    the standalone-script version of the tests/conftest.py discipline
+    (Spark local[N] role, BaseSparkTest.java:90).
+
+    jax >= 0.5 spells it ``jax_num_cpu_devices``; this environment's
+    0.4.x only honors the XLA_FLAGS host-platform flag, which the CPU
+    client reads at backend creation — so it must land in the env before
+    the first device query. Any inherited count flag is REPLACED (a
+    leftover =2 from a multihost worker env would otherwise silently win
+    and break every 8-device mesh). The `-m examples` smoke tier exists
+    precisely because examples carried a bare ``jax_num_cpu_devices``
+    update that this image's jax rejects at line one."""
+    import os
+
+    jax.config.update("jax_platforms", "cpu")
+    # strip any inherited count flag FIRST, on both branches: even where
+    # jax_num_cpu_devices exists, a leftover XLA_FLAGS count could still
+    # win at CPU-client creation (conftest applies the same discipline)
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
 def device_mesh(
     num_devices: Optional[int] = None,
     shape: Optional[Sequence[int]] = None,

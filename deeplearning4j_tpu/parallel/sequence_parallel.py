@@ -54,10 +54,14 @@ def multi_head_attention(q, k, v, *, causal: bool = False,
     softmax (variable-length batches)."""
     d = q.shape[-1]
     s = jnp.einsum("nqhd,nkhd->nhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
-    # softmax in f32 regardless of compute dtype: a bf16 exp/sum over
-    # thousands of keys loses mass (every other attention path — serial
-    # _attention, the ring body, the flash kernel — already upcasts)
-    s = s.astype(jnp.float32)
+    # softmax in AT LEAST f32 (ops/dtypes.softmax_dtype): a bf16 exp/sum
+    # over thousands of keys loses mass (every other attention path —
+    # serial _attention, the ring body, the flash kernel — already
+    # upcasts); f64 inputs stay f64 so the x64 gradcheck substrate keeps
+    # its resolution
+    from deeplearning4j_tpu.ops.dtypes import softmax_dtype
+
+    s = s.astype(softmax_dtype(s.dtype))
     if causal:
         qi = q_offset + jnp.arange(q.shape[1])
         ki = k_offset + jnp.arange(k.shape[1])

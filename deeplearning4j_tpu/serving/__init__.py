@@ -1,0 +1,66 @@
+"""Production serving engine — the subsystem the reference's one-record
+Camel route (dl4j-streaming/.../routes/DL4jServeRouteBuilder.java: load a
+serialized model, run output() per incoming record) never grew into.
+
+On TPU the per-record route is the inference-time twin of the op-by-op
+dispatch gap SURVEY §3.1 identifies at training time: every request pays a
+full device dispatch (~5ms through this chip's tunnel — BENCH_NOTES.md)
+for a batch-1 program, so the chip idles while requests queue. This
+package concentrates the counter-measures:
+
+  batcher.py    DynamicBatcher — bounded request queue coalescing
+                concurrent /predict requests into bucket-shaped batches
+                (ops/dispatch.bucket_size, so the steady state is
+                zero-retrace), flushing on deadline or bucket-full, with
+                backpressure (429 past capacity) and per-request timeouts.
+  decode.py     ContinuousDecoder — continuous-batching LM decode over a
+                fixed KV-cache slot pool: finished sequences are evicted
+                and queued prompts admitted mid-loop, so /generate
+                throughput no longer quantizes to the slowest sequence of
+                a static batch.
+  registry.py   ModelRegistry — named/versioned load → warmup → serve →
+                unload lifecycle (warmup pre-compiles the bucket set
+                before a model takes traffic; unload frees device
+                buffers). The ModelSerializer zip (reference
+                ModelSerializer.java:70-110) is the interchange format.
+  telemetry.py  ServingStats — p50/p95/p99 latency, queue depth,
+                batch-fill ratio, per-model dispatch_stats, exposed at
+                /metrics.
+  engine.py     ServingEngine — the stdlib-HTTP front door wiring the
+                four together (/predict, /generate, /metrics, /health,
+                /models).
+
+streaming/serving.py's ModelServer remains the compatibility surface: a
+thin subclass of ServingEngine with the original single-model contract.
+"""
+
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher,
+    QueueFullError,
+    RequestTimeoutError,
+)
+from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.telemetry import ServingStats
+
+__all__ = [
+    "ContinuousDecoder",
+    "DynamicBatcher",
+    "ModelRegistry",
+    "QueueFullError",
+    "RequestTimeoutError",
+    "ServingEngine",
+    "ServingStats",
+]
+
+
+def __getattr__(name):
+    # ContinuousDecoder resolves lazily (PEP 562): it pulls the whole
+    # models/transformer stack, which non-LM servers (and the bench's
+    # serving subprocess) never need — engine.py defers the same import
+    # into _decoder_for for the same reason.
+    if name == "ContinuousDecoder":
+        from deeplearning4j_tpu.serving.decode import ContinuousDecoder
+
+        return ContinuousDecoder
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,247 @@
+"""Dynamic request batching: many concurrent /predict calls, few dispatches.
+
+The reference route (DL4jServeRouteBuilder.java) and its mirror
+(streaming/serving.py pre-rewrite) run ``output()`` once PER RECORD: on
+this chip that is one ~5ms dispatch per request for a batch-1 program —
+the training-time op-granularity gap (SURVEY §3.1) reappearing at
+inference. The batcher closes it the same way fit_batches closed the
+training side: a bounded queue coalesces whatever requests are in flight
+into ONE bucket-shaped batch per dispatch.
+
+Batch shapes come from the shared bucketing policy (ops/dispatch.py
+``bucket_size``): a flushed batch of any size pads up to the
+powers-of-two-and-1.5x ladder, so the steady state compiles O(log
+max_batch) programs total and then never retraces — the zero-retrace hot
+path, now serving. Pad rows are inference-only and provably inert (BN uses
+running stats, dropout is off, every op is row-independent; the
+equivalence test asserts byte-identical rows against direct ``output()``).
+
+Flow control, in order:
+  * bucket-full flush     — max_batch real rows waiting -> dispatch now;
+  * deadline flush        — the OLDEST queued request has waited
+                            max_wait_ms -> dispatch whatever is here
+                            (bounded added latency);
+  * backpressure          — queue past queue_capacity rows -> submit()
+                            raises QueueFullError (the HTTP layer turns
+                            this into 429, the standard shed signal);
+  * per-request timeout   — a request older than its deadline is answered
+                            with RequestTimeoutError (504), never silently
+                            dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.ops import dispatch
+from deeplearning4j_tpu.serving.telemetry import ServingStats
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the request queue is at capacity (HTTP 429)."""
+
+
+class RequestTimeoutError(TimeoutError):
+    """The request's deadline expired before its batch ran (HTTP 504)."""
+
+
+def _resolve(fut: Future, result=None, exception=None) -> bool:
+    """Resolve a future if the client is still waiting. Returns False for
+    futures already done OR cancelled by a timed-out waiter; the done()
+    pre-check races the waiter's cancel(), so InvalidStateError closes
+    the window — a abandoned request must not crash the worker or count
+    as a completion."""
+    try:
+        if fut.done():
+            return False
+        if exception is not None:
+            fut.set_exception(exception)
+        else:
+            fut.set_result(result)
+        return True
+    except Exception:  # noqa: BLE001 — InvalidStateError/CancelledError race
+        return False
+
+
+class _Request:
+    __slots__ = ("rows", "future", "deadline", "enqueued")
+
+    def __init__(self, rows: np.ndarray, deadline: float) -> None:
+        self.rows = rows
+        self.future: Future = Future()
+        self.deadline = deadline
+        self.enqueued = time.monotonic()
+
+
+class DynamicBatcher:
+    """Coalesce concurrent row-wise inference requests into bucket batches.
+
+    ``infer_fn(batch [N, ...]) -> np.ndarray [N, ...]`` is the model call;
+    it is invoked from the single worker thread (so models whose output
+    path is not thread-safe need no extra lock) and is expected to pad
+    internally via the shared inference bucketing (both containers'
+    ``output()`` — nn/multilayer.py / nn/graph.py — already do).
+    """
+
+    def __init__(self, infer_fn: Callable[[np.ndarray], np.ndarray], *,
+                 max_batch: int = 64, max_wait_ms: float = 10.0,
+                 queue_capacity: int = 512,
+                 default_timeout_s: float = 60.0,
+                 stats: Optional[ServingStats] = None) -> None:
+        if max_batch < 1 or queue_capacity < 1:
+            raise ValueError("max_batch and queue_capacity must be >= 1")
+        self._infer = infer_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.queue_capacity = int(queue_capacity)
+        self.default_timeout_s = float(default_timeout_s)
+        self.stats = stats if stats is not None else ServingStats()
+        self._q: deque = deque()
+        self._q_rows = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._running = True
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="dynamic-batcher")
+        self._worker.start()
+
+    # -- client side ------------------------------------------------------
+    def submit(self, rows, timeout_s: Optional[float] = None) -> Future:
+        """Enqueue ``rows`` ([k, ...] — one request may carry several rows)
+        and return a Future resolving to the [k, ...] outputs. Raises
+        QueueFullError when the queue is at capacity (backpressure)."""
+        rows = np.asarray(rows)
+        if rows.ndim < 1 or rows.shape[0] < 1:
+            raise ValueError("submit() needs at least one row")
+        self.stats.record_request()
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.default_timeout_s)
+        req = _Request(rows, deadline)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("batcher is stopped")
+            # an EMPTY queue always admits (an oversize request larger
+            # than queue_capacity passes through as its own batch —
+            # _take_batch handles it; a hard reject would 429 it forever)
+            if (self._q_rows > 0
+                    and self._q_rows + rows.shape[0] > self.queue_capacity):
+                self.stats.record_rejected()
+                raise QueueFullError(
+                    f"queue at capacity ({self._q_rows}/"
+                    f"{self.queue_capacity} rows)")
+            self._q.append(req)
+            self._q_rows += rows.shape[0]
+            self.stats.set_queue_depth(self._q_rows)
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, rows, timeout_s: Optional[float] = None) -> np.ndarray:
+        """submit() + wait; raises RequestTimeoutError past the deadline."""
+        budget = timeout_s if timeout_s is not None else self.default_timeout_s
+        fut = self.submit(rows, timeout_s=budget)
+        try:
+            return fut.result(timeout=budget + self.max_wait_s)
+        except RequestTimeoutError:
+            raise  # worker-side expiry — already counted in _take_batch
+        # on 3.10 concurrent.futures.TimeoutError is NOT the builtin
+        except (TimeoutError, FutureTimeoutError) as e:
+            # cancel so a worker finishing the batch later doesn't record
+            # a phantom completion/latency for a response nobody received
+            fut.cancel()
+            self.stats.record_timeout()
+            raise RequestTimeoutError("request timed out in queue") from e
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._worker.join(timeout=5)
+        # fail whatever is still queued — a stopped server must not leave
+        # clients blocked on futures nobody will resolve
+        with self._cond:
+            while self._q:
+                req = self._q.popleft()
+                _resolve(req.future,
+                         exception=RuntimeError("batcher stopped"))
+            self._q_rows = 0
+
+    # -- worker side ------------------------------------------------------
+    def _take_batch(self):
+        """Under the lock: wait for work, honor the flush rules, and pop
+        whole requests up to max_batch rows (one oversize request passes
+        through alone — its rows are already a batch)."""
+        with self._cond:
+            while self._running and not self._q:
+                self._cond.wait()
+            if not self._q:
+                return None  # stopped and drained
+            flush_at = self._q[0].enqueued + self.max_wait_s
+            while (self._running and self._q_rows < self.max_batch
+                   and time.monotonic() < flush_at):
+                self._cond.wait(timeout=max(0.0,
+                                            flush_at - time.monotonic()))
+            now = time.monotonic()
+            taken, rows = [], 0
+            while self._q:
+                req = self._q[0]
+                if req.deadline < now:
+                    # expired in queue: answer 504 and reclaim the rows
+                    self._q.popleft()
+                    self._q_rows -= req.rows.shape[0]
+                    if _resolve(req.future, exception=RequestTimeoutError(
+                            "request expired before its batch ran")):
+                        self.stats.record_timeout()
+                    continue
+                if taken and rows + req.rows.shape[0] > self.max_batch:
+                    break
+                if taken and req.rows.shape[1:] != taken[0].rows.shape[1:]:
+                    # row-shape mismatch: stop the batch here (FIFO; the
+                    # odd request heads the NEXT batch) — one malformed
+                    # request must fail alone, never poison the batch it
+                    # happened to share a window with
+                    break
+                self._q.popleft()
+                self._q_rows -= req.rows.shape[0]
+                taken.append(req)
+                rows += req.rows.shape[0]
+            self.stats.set_queue_depth(self._q_rows)
+            return taken
+
+    def _run(self) -> None:
+        while True:
+            taken = self._take_batch()
+            if taken is None:
+                return
+            if not taken:
+                continue  # everything in the window had expired
+            batch = (taken[0].rows if len(taken) == 1
+                     else np.concatenate([r.rows for r in taken], axis=0))
+            n = batch.shape[0]
+            # fill telemetry mirrors the model's own bucketing decision
+            # (ops/dispatch.inference_bucket): pad rows exist only when
+            # bucketing is on and n is not already a bucket size
+            padded_to = (n if dispatch.bucketing_mode() == "off"
+                         else max(dispatch.bucket_size(n), n))
+            self.stats.record_batch(n, padded_to)
+            try:
+                out = np.asarray(self._infer(batch))
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                # per-request error accounting happens at the boundary
+                # that answers the client (engine handler / predict
+                # caller) — recording here too would double-count
+                for req in taken:
+                    _resolve(req.future, exception=e)
+                continue
+            i = 0
+            for req in taken:
+                k = req.rows.shape[0]
+                if _resolve(req.future, result=out[i:i + k]):
+                    self.stats.record_latency(time.monotonic() - req.enqueued)
+                i += k

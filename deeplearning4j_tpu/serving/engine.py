@@ -1,0 +1,410 @@
+"""ServingEngine: the HTTP front door over batcher + decoder + registry.
+
+Replaces the request-at-a-time core of the reference's serving route
+(DL4jServeRouteBuilder.java — restore one checkpoint, run output() per
+record) with the dynamically-batched engine while keeping the route's
+wire surface (streaming/serving.ModelServer subclasses this unchanged):
+
+  POST /predict   {"record": [...]}           -> {"output": [...]}
+                  {"record_base64": "..."}     -> {"output": [...]}
+                  {"batch": [[...], ...]}      -> {"outputs": [[...], ...]}
+                  optional: "model", "version", "timeout_s"
+                  429 when the batcher queue is full (backpressure),
+                  504 when the request's deadline expires in queue.
+  POST /generate  {"tokens": [[ids]], "n_new": K, "temperature"?,
+                  "top_k"?, "top_p"?, "seed"?} -> {"tokens": [[ids]]}
+                  (continuous-batching slot pool when the model supports
+                  it and no static filter is requested; lm.generate
+                  otherwise)
+  GET  /health    {"ok": true, "model": "<type>", "models": [...]}
+  GET  /metrics   {"serving": <ServingStats>, "models": [<per-model
+                  state incl. dispatch_stats>]}
+  GET  /models    registry listing; POST /models {"action": load|warmup|
+                  serve|unload, ...} drives the lifecycle.
+
+Env knobs (read at engine construction):
+  DL4J_TPU_SERVE_BATCH       "0" disables dynamic batching (naive locked
+                             per-request path — the bench's comparison leg)
+  DL4J_TPU_SERVE_MAX_BATCH   batcher flush size (default 64)
+  DL4J_TPU_SERVE_MAX_WAIT_MS batcher deadline flush (default 10)
+  DL4J_TPU_SERVE_QUEUE_CAP   queued rows before 429 (default 512)
+  DL4J_TPU_SERVE_TIMEOUT_S   default per-request deadline (default 60)
+  DL4J_TPU_SERVE_SLOTS       continuous-decode slot pool size (default 4)
+  DL4J_TPU_SERVE_CONTINUOUS  "0" routes /generate to lm.generate always
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher,
+    QueueFullError,
+    RequestTimeoutError,
+)
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.telemetry import ServingStats
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class ServingEngine:
+    def __init__(self, model=None, model_path: Optional[str] = None,
+                 port: int = 0, input_shape=None, *,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 queue_capacity: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 slots: Optional[int] = None) -> None:
+        self.max_batch = int(max_batch if max_batch is not None
+                             else _env_float("DL4J_TPU_SERVE_MAX_BATCH", 64))
+        self.max_wait_ms = (max_wait_ms if max_wait_ms is not None
+                            else _env_float("DL4J_TPU_SERVE_MAX_WAIT_MS", 10))
+        self.queue_capacity = int(
+            queue_capacity if queue_capacity is not None
+            else _env_float("DL4J_TPU_SERVE_QUEUE_CAP", 512))
+        self.request_timeout_s = (
+            request_timeout_s if request_timeout_s is not None
+            else _env_float("DL4J_TPU_SERVE_TIMEOUT_S", 60))
+        self.slots = int(slots if slots is not None
+                         else _env_float("DL4J_TPU_SERVE_SLOTS", 4))
+        self.batching_enabled = (
+            os.environ.get("DL4J_TPU_SERVE_BATCH", "").strip().lower()
+            not in ("0", "off", "false", "no"))
+        self.continuous_enabled = (
+            os.environ.get("DL4J_TPU_SERVE_CONTINUOUS", "").strip().lower()
+            not in ("0", "off", "false", "no"))
+        self.stats = ServingStats()
+        self.registry = ModelRegistry()
+        self._batchers: Dict[str, DynamicBatcher] = {}
+        self._decoders: Dict[str, Any] = {}
+        self._no_decoder: set = set()  # records probed and found ineligible
+        self._lock = threading.Lock()       # naive path + generate serialization
+        self._engine_lock = threading.Lock()  # batcher/decoder creation
+        if model is not None or model_path is not None:
+            rec = self.registry.load("default", model=model,
+                                     model_path=model_path,
+                                     input_shape=input_shape)
+            self.registry.serve(rec.name, rec.version)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- compatibility surface (streaming/serving.ModelServer) ------------
+    @property
+    def model(self):
+        rec = self.registry.default()
+        return rec.model if rec is not None else None
+
+    @property
+    def input_shape(self):
+        rec = self.registry.default()
+        return rec.input_shape if rec is not None else None
+
+    def predict(self, x: np.ndarray,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        """Batch-of-rows inference through the engine (dynamic batcher when
+        enabled, the locked direct path otherwise)."""
+        return self.predict_for(None, None, x, timeout_s=timeout_s)
+
+    def predict_for(self, name, version, x,
+                    timeout_s: Optional[float] = None) -> np.ndarray:
+        rec = self.registry.get(name, version)
+        if rec.model is None:
+            raise KeyError(f"{rec.key} is unloaded")
+        x = np.asarray(x)
+        if not self.batching_enabled:
+            return self._direct_output(rec, x)
+        batcher = self._batcher_for(rec)
+        return batcher.predict(x, timeout_s=timeout_s)
+
+    def generate(self, tokens: np.ndarray, n_new: int, *,
+                 temperature: float = 1.0, seed: int = 0,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 name=None, version=None) -> np.ndarray:
+        """LM sampling: the continuous slot pool for plain temperature
+        sampling on eligible models; lm.generate for static top_k/top_p
+        filters, mesh-sharded or MoE models (the filters are compiled
+        per-(n_new, k) there — models/transformer._filter_logits)."""
+        rec = self.registry.get(name, version)
+        model = rec.model
+        if model is None or not hasattr(model, "generate"):
+            raise ValueError(f"model {rec.key} has no generate()")
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim == 1:
+            tokens = tokens[None]
+        decoder = (self._decoder_for(rec)
+                   if top_k is None and top_p is None else None)
+        if decoder is not None:
+            out = decoder.generate(tokens, int(n_new),
+                                   temperature=float(temperature),
+                                   seed=int(seed))
+            return np.asarray(out)
+        import jax.numpy as jnp
+
+        with self._lock:
+            out = model.generate(jnp.asarray(tokens, jnp.int32), int(n_new),
+                                 temperature=float(temperature),
+                                 seed=int(seed), top_k=top_k, top_p=top_p)
+        self.stats.record_tokens(int(np.asarray(out).size))
+        return np.asarray(out)
+
+    # -- internals --------------------------------------------------------
+    def _direct_output(self, rec, x: np.ndarray) -> np.ndarray:
+        """The naive per-request path the batcher replaces (kept for the
+        DL4J_TPU_SERVE_BATCH=0 comparison and the bench's baseline): one
+        locked output() dispatch per call."""
+        if rec.input_shape is not None:
+            x = x.reshape((x.shape[0],) + rec.input_shape)
+        with self._lock:
+            out = rec.model.output(x)
+        out0 = out[0] if isinstance(out, (list, tuple)) else out
+        return np.asarray(out0)
+
+    def _batcher_for(self, rec) -> DynamicBatcher:
+        with self._engine_lock:
+            batcher = self._batchers.get(rec.key)
+            if batcher is None:
+                shape = rec.input_shape
+                model = rec.model
+
+                def infer(batch, _model=model, _shape=shape):
+                    if _shape is not None:
+                        batch = np.asarray(batch).reshape(
+                            (batch.shape[0],) + _shape)
+                    out = _model.output(batch)
+                    out0 = out[0] if isinstance(out, (list, tuple)) else out
+                    return np.asarray(out0)
+
+                batcher = DynamicBatcher(
+                    infer, max_batch=self.max_batch,
+                    max_wait_ms=self.max_wait_ms,
+                    queue_capacity=self.queue_capacity,
+                    default_timeout_s=self.request_timeout_s,
+                    stats=self.stats)
+                self._batchers[rec.key] = batcher
+            return batcher
+
+    def _decoder_for(self, rec):
+        if not self.continuous_enabled:
+            return None
+        with self._engine_lock:
+            if rec.key in self._no_decoder:
+                return None
+            decoder = self._decoders.get(rec.key)
+            if decoder is None:
+                # eligibility is the KV-slot contract: a single-device
+                # dense TransformerLM (serving/decode.py gate)
+                if getattr(rec.model, "_run_cfg", None) is None:
+                    self._no_decoder.add(rec.key)
+                    return None
+                from deeplearning4j_tpu.serving.decode import (
+                    ContinuousDecoder,
+                )
+
+                try:
+                    decoder = ContinuousDecoder(
+                        rec.model, slots=self.slots, stats=self.stats,
+                        default_timeout_s=max(self.request_timeout_s, 300.0))
+                except ValueError:
+                    self._no_decoder.add(rec.key)
+                    return None
+                self._decoders[rec.key] = decoder
+            return decoder
+
+    # -- HTTP -------------------------------------------------------------
+    def _make_handler(self):
+        engine = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_json(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n))
+
+            def do_GET(self):
+                if self.path == "/health":
+                    rec = engine.registry.default()
+                    self._send(200, {
+                        "ok": True,
+                        "model": (type(rec.model).__name__
+                                  if rec is not None else None),
+                        "models": [r["name"] + "@v" + str(r["version"])
+                                   for r in engine.registry.describe()],
+                    })
+                elif self.path == "/metrics":
+                    self._send(200, engine.metrics())
+                elif self.path == "/models":
+                    self._send(200, {
+                        "models": engine.registry.describe(),
+                        "default": (engine.registry.default().key
+                                    if engine.registry.default() else None),
+                    })
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    if self.path == "/predict":
+                        self._do_predict()
+                    elif self.path == "/generate":
+                        self._do_generate()
+                    elif self.path == "/models":
+                        self._do_models()
+                    else:
+                        self._send(404, {"error": "not found"})
+                except QueueFullError as e:
+                    # rejected counter already bumped at submit()
+                    self._send(429, {"error": f"QueueFull: {e}"})
+                except RequestTimeoutError as e:
+                    # timeout counter already bumped where it expired
+                    # (batcher worker / batcher.predict / decoder loop)
+                    self._send(504, {"error": f"Timeout: {e}"})
+                except FutureTimeoutError as e:
+                    engine.stats.record_timeout()  # raw future wait only
+                    self._send(504, {"error": f"Timeout: {e}"})
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    engine.stats.record_error()
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+            def _do_predict(self):
+                from deeplearning4j_tpu.streaming.conversion import (
+                    decode_record_base64,
+                )
+
+                payload = self._read_json()
+                if "record_base64" in payload:
+                    x = decode_record_base64(payload["record_base64"])[None]
+                elif "record" in payload:
+                    x = np.asarray(payload["record"], np.float32)[None]
+                elif "batch" in payload:
+                    x = np.asarray(payload["batch"], np.float32)
+                else:
+                    self._send(400,
+                               {"error": "need record|record_base64|batch"})
+                    return
+                timeout = payload.get("timeout_s")
+                out = engine.predict_for(
+                    payload.get("model"), payload.get("version"), x,
+                    # `is not None`: an explicit 0 means no-wait, not
+                    # "use the 60s default"
+                    timeout_s=(float(timeout) if timeout is not None
+                               else None))
+                key = "outputs" if "batch" in payload else "output"
+                val = out.tolist() if "batch" in payload else out[0].tolist()
+                self._send(200, {key: val})
+
+            def _do_generate(self):
+                payload = self._read_json()
+                toks = np.asarray(payload["tokens"], np.int32)
+                # coerce filter args: JSON numbers often arrive as floats,
+                # and a float top_k would both fail lax.top_k and pollute
+                # the compile cache key
+                tk = payload.get("top_k")
+                tp = payload.get("top_p")
+                out = engine.generate(
+                    toks, int(payload.get("n_new", 16)),
+                    temperature=float(payload.get("temperature", 1.0)),
+                    seed=int(payload.get("seed", 0)),
+                    top_k=int(tk) if tk is not None else None,
+                    top_p=float(tp) if tp is not None else None,
+                    name=payload.get("model"),
+                    version=payload.get("version"))
+                self._send(200, {"tokens": out.tolist()})
+
+            def _do_models(self):
+                payload = self._read_json()
+                action = payload.get("action")
+                name = payload.get("name")
+                version = payload.get("version")
+                if action == "load":
+                    rec = engine.registry.load(
+                        name, model_path=payload.get("path"),
+                        input_shape=payload.get("input_shape"))
+                    self._send(200, rec.describe())
+                elif action == "warmup":
+                    self._send(200, engine.registry.warmup(
+                        name, version,
+                        max_batch=int(payload.get("max_batch",
+                                                  engine.max_batch)),
+                        gen_tokens=int(payload.get("gen_tokens", 0))))
+                elif action == "serve":
+                    rec = engine.registry.serve(name, version)
+                    self._send(200, rec.describe())
+                elif action == "unload":
+                    engine.retire(name, version)
+                    self._send(200, engine.registry.get(name,
+                                                        version).describe())
+                else:
+                    self._send(400, {"error": "action must be "
+                                     "load|warmup|serve|unload"})
+
+        return Handler
+
+    def metrics(self) -> Dict[str, Any]:
+        return {"serving": self.stats.snapshot(),
+                "models": self.registry.describe()}
+
+    def retire(self, name, version=None) -> None:
+        """Unload a record AND tear down its batcher/decoder."""
+        rec = self.registry.get(name, version)
+        with self._engine_lock:
+            batcher = self._batchers.pop(rec.key, None)
+            decoder = self._decoders.pop(rec.key, None)
+            self._no_decoder.discard(rec.key)
+        if batcher is not None:
+            batcher.stop()
+        if decoder is not None:
+            decoder.stop()
+        self.registry.unload(rec.name, rec.version)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        with self._engine_lock:
+            batchers = list(self._batchers.values())
+            decoders = list(self._decoders.values())
+            self._batchers.clear()
+            self._decoders.clear()
+        for b in batchers:
+            b.stop()
+        for d in decoders:
+            d.stop()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

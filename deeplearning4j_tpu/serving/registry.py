@@ -1,0 +1,236 @@
+"""Model registry: named/versioned load → warmup → serve → unload.
+
+The reference's serving route binds ONE model at route-build time
+(DL4jServeRouteBuilder.java: the Camel route restores a single
+ModelSerializer checkpoint and serves it until the route dies); rolling a
+new model means rolling the route. A production endpoint needs the
+lifecycle to be data, not deployment:
+
+  load     restore a checkpoint (utils/serialization.ModelSerializer —
+           the reference's three-part zip, ModelSerializer.java:70-110) or
+           adopt a live model object, under a (name, version) key;
+  warmup   pre-compile the inference bucket ladder (ops/dispatch
+           bucket_size) BEFORE the model takes traffic, so the first real
+           request never pays an XLA trace — the serving twin of the
+           persistent-compile-cache rationale (a compile paid at warmup is
+           free at p99);
+  serve    atomically switch the default traffic target to (name,
+           version) — the previous version keeps serving in-flight
+           requests it already received;
+  unload   drop the registry's references and DELETE the device buffers
+           (jax array .delete()), so a retired version's params/optimizer
+           HBM is reclaimed immediately instead of at GC's leisure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.ops import dispatch
+
+# model attributes that hold device-buffer pytrees — walked by unload()
+_BUFFER_ATTRS = ("params", "states", "updater_state", "opt")
+
+
+def bucket_ladder(max_batch: int) -> List[int]:
+    """The distinct bucket sizes a batcher can dispatch for batches of
+    1..max_batch rows — the set warmup must pre-compile."""
+    return sorted({dispatch.bucket_size(n) for n in range(1, max_batch + 1)})
+
+
+class ModelRecord:
+    """One (name, version) entry. ``state`` walks loaded → warm → serving
+    → unloaded; the registry is the only writer."""
+
+    def __init__(self, name: str, version: int, model, *,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 path: Optional[str] = None) -> None:
+        self.name = name
+        self.version = int(version)
+        self.model = model
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.path = path
+        self.state = "loaded"
+        self.loaded_ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        self.warmed_buckets: List[int] = []
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}@v{self.version}"
+
+    def describe(self) -> Dict[str, Any]:
+        out = {
+            "name": self.name,
+            "version": self.version,
+            "state": self.state,
+            "model_type": type(self.model).__name__ if self.model is not None
+            else None,
+            "loaded_ts": self.loaded_ts,
+            "warmed_buckets": list(self.warmed_buckets),
+        }
+        if self.input_shape:
+            out["input_shape"] = list(self.input_shape)
+        stats = getattr(self.model, "dispatch_stats", None)
+        if stats is not None:
+            out["dispatch_stats"] = stats.snapshot()
+        return out
+
+
+class ModelRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._records: Dict[str, Dict[int, ModelRecord]] = {}
+        self._default: Optional[Tuple[str, int]] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def load(self, name: str, model=None, model_path: Optional[str] = None,
+             input_shape=None) -> ModelRecord:
+        """Register a live model or restore a ModelSerializer zip; the
+        version is auto-assigned (monotonic per name, starting at 1)."""
+        if model is None:
+            if model_path is None:
+                raise ValueError("need model or model_path")
+            from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+            model = ModelSerializer.restore(model_path)
+        with self._lock:
+            versions = self._records.setdefault(name, {})
+            version = max(versions) + 1 if versions else 1
+            rec = ModelRecord(name, version, model,
+                              input_shape=input_shape, path=model_path)
+            versions[version] = rec
+            # NOT auto-promoted to the traffic default: only serve()
+            # switches traffic (the documented load -> warmup -> serve
+            # lifecycle — a cold record must never take requests because
+            # it happened to be loaded first)
+            return rec
+
+    def warmup(self, name: Optional[str] = None,
+               version: Optional[int] = None, *, max_batch: int = 64,
+               sample_row: Optional[np.ndarray] = None,
+               gen_tokens: int = 0) -> Dict[str, Any]:
+        """Compile the model's inference programs for every bucket size a
+        batcher can dispatch, before the record takes traffic.
+
+        The sample row defaults to zeros of ``input_shape`` (token models
+        — no input_shape but a generate() — warm with a [b, 2] id batch).
+        ``gen_tokens > 0`` additionally warms the LM sampler for that
+        n_new (one compile per n_new — models/transformer._sample_kv_fn)."""
+        rec = self.get(name, version)
+        model = rec.model
+        if model is None:
+            raise ValueError(f"{rec.key} is unloaded")
+        if sample_row is not None:
+            row = np.asarray(sample_row)
+        elif rec.input_shape is not None:
+            row = np.zeros(rec.input_shape, np.float32)
+        elif hasattr(model, "generate"):  # token-id model (the LM)
+            row = np.zeros((2,), np.int32)
+        else:
+            raise ValueError(
+                f"{rec.key}: warmup needs input_shape or sample_row")
+        t0 = time.perf_counter()
+        ladder = bucket_ladder(max_batch)
+        for b in ladder:
+            batch = np.broadcast_to(row, (b,) + row.shape)
+            out = model.output(batch)
+            np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+        if gen_tokens and hasattr(model, "generate"):
+            np.asarray(model.generate(
+                np.zeros((1, 2), np.int32), int(gen_tokens)))
+        dt = time.perf_counter() - t0
+        with self._lock:
+            rec.warmed_buckets = ladder
+            if rec.state == "loaded":
+                rec.state = "warm"
+        return {"model": rec.key, "buckets": ladder,
+                "gen_tokens": int(gen_tokens), "seconds": round(dt, 3)}
+
+    def serve(self, name: Optional[str] = None,
+              version: Optional[int] = None) -> ModelRecord:
+        """Make (name, version) the default traffic target."""
+        rec = self.get(name, version)
+        if rec.model is None:
+            raise ValueError(f"{rec.key} is unloaded")
+        with self._lock:
+            prev = self._default
+            self._default = (rec.name, rec.version)
+            rec.state = "serving"
+            if prev is not None and prev != self._default:
+                old = self._records.get(prev[0], {}).get(prev[1])
+                if old is not None and old.state == "serving":
+                    old.state = "warm"
+        return rec
+
+    def unload(self, name: str, version: Optional[int] = None) -> ModelRecord:
+        """Drop the record's model and free its device buffers NOW."""
+        rec = self.get(name, version)
+        with self._lock:
+            if self._default == (rec.name, rec.version):
+                self._default = None
+            model, rec.model, rec.state = rec.model, None, "unloaded"
+        if model is not None:
+            _delete_device_buffers(model)
+        return rec
+
+    # -- lookup -----------------------------------------------------------
+    def get(self, name: Optional[str] = None,
+            version: Optional[int] = None) -> ModelRecord:
+        with self._lock:
+            if name is None:
+                if self._default is None:
+                    raise KeyError("no model is serving")
+                name, default_version = self._default
+                if version is None:
+                    version = default_version
+            versions = self._records.get(name)
+            if not versions:
+                raise KeyError(f"unknown model {name!r}")
+            if version is None:
+                # newest loaded version of the name (serving wins if set)
+                if self._default and self._default[0] == name:
+                    version = self._default[1]
+                else:
+                    version = max(versions)
+            rec = versions.get(int(version))
+            if rec is None:
+                raise KeyError(f"unknown version {name}@v{version}")
+            return rec
+
+    def default(self) -> Optional[ModelRecord]:
+        with self._lock:
+            if self._default is None:
+                return None
+            return self._records[self._default[0]][self._default[1]]
+
+    def describe(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            recs = [r for vs in self._records.values() for r in vs.values()]
+        return [r.describe() for r in
+                sorted(recs, key=lambda r: (r.name, r.version))]
+
+
+def _delete_device_buffers(model) -> None:
+    """Best-effort immediate free of a model's device arrays (HBM is the
+    scarce resource a retired version must hand back)."""
+    import jax
+
+    for attr in _BUFFER_ATTRS:
+        tree = getattr(model, attr, None)
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            delete = getattr(leaf, "delete", None)
+            if delete is not None:
+                try:
+                    delete()
+                except Exception:  # noqa: BLE001 — already-deleted/shared leaves
+                    pass
+        try:
+            setattr(model, attr, None)
+        except Exception:  # noqa: BLE001 — read-only attrs stay
+            pass

@@ -1,0 +1,128 @@
+"""Serving telemetry: the counters a production endpoint is judged by.
+
+The reference's serving route has no metrics at all (the Camel route in
+DL4jServeRouteBuilder.java just transforms bodies); its training side got
+them through IterationListener / Spark stats (StatsUtils.java:65). Serving
+needs the inference-side equivalents — latency percentiles, queue depth,
+batch-fill ratio — because the dynamic batcher trades a bounded amount of
+per-request latency (the max-wait window) for dispatch amortization, and
+only these numbers show whether the trade is paying.
+
+Latencies are kept in a fixed-size ring (last ``window`` observations) so
+the percentiles track the RECENT regime — a tunnel hiccup an hour ago must
+not pollute this minute's p99 forever — and memory stays bounded under
+heavy traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class ServingStats:
+    """Thread-safe serving counters + latency reservoir.
+
+    batch-fill ratio: real rows / (real + pad) rows over all batches the
+    batcher dispatched — 1.0 means every dispatched program was full of
+    real work; low values mean the max-wait window is flushing nearly
+    empty buckets (raise max_wait_ms or traffic).
+    """
+
+    def __init__(self, window: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=int(window))
+        self.requests = 0          # submitted to the engine
+        self.completed = 0         # answered successfully
+        self.errors = 0            # model/payload errors
+        self.rejected = 0          # backpressure (HTTP 429)
+        self.timeouts = 0          # per-request deadline expired (504)
+        self.batches = 0           # batcher dispatches
+        self.batched_rows = 0      # real rows across all batches
+        self.padded_rows = 0       # pad rows across all batches
+        self.generated_tokens = 0  # continuous-decode output tokens
+        # per-component depths (batcher rows / decode pending prompts):
+        # one shared last-writer-wins field would let an idle component
+        # overwrite the backlog the other is about to 429 on
+        self.queue_depths: Dict[str, int] = {}
+
+    # -- recording --------------------------------------------------------
+    def record_request(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self._lat.append(float(seconds))
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def record_batch(self, real_rows: int, padded_to: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += int(real_rows)
+            self.padded_rows += int(padded_to) - int(real_rows)
+
+    def record_tokens(self, n: int) -> None:
+        with self._lock:
+            self.generated_tokens += int(n)
+
+    def set_queue_depth(self, depth: int,
+                        component: str = "batcher") -> None:
+        with self._lock:
+            self.queue_depths[component] = int(depth)
+
+    # -- reading ----------------------------------------------------------
+    def latency_ms(self) -> Dict[str, Optional[float]]:
+        """p50/p95/p99 of the recent-latency ring, in milliseconds."""
+        with self._lock:
+            lat = np.asarray(self._lat, np.float64)
+        if lat.size == 0:
+            return {"p50": None, "p95": None, "p99": None, "count": 0}
+        return {
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p95": round(float(np.percentile(lat, 95)) * 1e3, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "count": int(lat.size),
+        }
+
+    def batch_fill_ratio(self) -> Optional[float]:
+        with self._lock:
+            total = self.batched_rows + self.padded_rows
+            if total == 0:
+                return None
+            return round(self.batched_rows / total, 4)
+
+    def snapshot(self) -> Dict[str, Any]:
+        lat = self.latency_ms()
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "completed": self.completed,
+                "errors": self.errors,
+                "rejected_429": self.rejected,
+                "timeouts": self.timeouts,
+                "batches": self.batches,
+                "batched_rows": self.batched_rows,
+                "padded_rows": self.padded_rows,
+                "generated_tokens": self.generated_tokens,
+                "queue_depth": sum(self.queue_depths.values()),
+                "queue_depths": dict(self.queue_depths),
+            }
+        out["latency_ms"] = lat
+        out["batch_fill_ratio"] = self.batch_fill_ratio()
+        return out

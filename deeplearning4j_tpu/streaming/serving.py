@@ -1,8 +1,13 @@
-"""Model serving endpoint.
+"""Model serving endpoint — compatibility front-end over serving/engine.
 
 Capability mirror of DL4jServeRouteBuilder (dl4j-streaming/.../streaming/
 routes/DL4jServeRouteBuilder.java: Camel route that loads a serialized model
-and runs output() on each incoming record): a stdlib HTTP server exposing
+and runs output() on each incoming record), now a thin subclass of the
+production engine (deeplearning4j_tpu/serving/): the wire surface below is
+unchanged, but /predict requests are dynamically batched into bucket-shaped
+dispatches (serving/batcher.py), /generate runs the continuous-batching
+KV-slot pool when the model supports it (serving/decode.py), and the engine
+adds /metrics plus the /models registry lifecycle on top.
 
   POST /predict   {"record": [..floats..]}            -> {"output": [...]}
                   {"record_base64": "<b64 floats>"}   -> {"output": [...]}
@@ -10,151 +15,34 @@ and runs output() on each incoming record): a stdlib HTTP server exposing
   POST /generate  {"tokens": [[ids]], "n_new": K, ...} -> {"tokens": [[ids]]}
                   (flagship LM sampling through the KV-cache decoder)
   GET  /health    {"ok": true, "model": "<type>"}
+  GET  /metrics   serving telemetry (latency percentiles, queue depth,
+                  batch-fill ratio, per-model dispatch_stats)
 
 The model is restored once at startup (ModelSerializer.restore — the same
-checkpoint the reference route consumes) and shared across requests; the
-jitted forward compiles on first request per batch shape, so sticky batch
-sizes serve at device speed.
+checkpoint the reference route consumes) and shared across requests; with
+warmup (serving/registry.py) the bucket ladder pre-compiles before traffic,
+so even the first ragged burst serves at device speed.
 """
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-import numpy as np
-
-from deeplearning4j_tpu.streaming.conversion import decode_record_base64
+from deeplearning4j_tpu.serving.engine import ServingEngine
 
 
-class ModelServer:
+class ModelServer(ServingEngine):
+    """The original single-model server contract: construct with a live
+    network or a ModelSerializer zip, ``start()``, post records at
+    ``url``. All heavy lifting now lives in ServingEngine."""
+
     def __init__(self, model=None, model_path: Optional[str] = None,
-                 port: int = 0, input_shape=None):
-        """model: a live network, or model_path: a ModelSerializer zip."""
-        if model is None:
-            if model_path is None:
-                raise ValueError("need model or model_path")
-            from deeplearning4j_tpu.utils.serialization import ModelSerializer
+                 port: int = 0, input_shape=None, **engine_kwargs) -> None:
+        if model is None and model_path is None:
+            raise ValueError("need model or model_path")
+        super().__init__(model=model, model_path=model_path, port=port,
+                         input_shape=input_shape, **engine_kwargs)
 
-            model = ModelSerializer.restore(model_path)
-        self.model = model
-        self.input_shape = tuple(input_shape) if input_shape else None
-        self._lock = threading.Lock()
-        server = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _send(self, code: int, obj):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path == "/health":
-                    self._send(200, {"ok": True,
-                                     "model": type(server.model).__name__})
-                else:
-                    self._send(404, {"error": "not found"})
-
-            def _read_json(self):
-                n = int(self.headers.get("Content-Length", 0))
-                return json.loads(self.rfile.read(n))
-
-            def do_POST(self):
-                if self.path == "/generate":
-                    self._do_generate()
-                    return
-                if self.path != "/predict":
-                    self._send(404, {"error": "not found"})
-                    return
-                try:
-                    payload = self._read_json()
-                    if "record_base64" in payload:
-                        x = decode_record_base64(payload["record_base64"])[None]
-                    elif "record" in payload:
-                        x = np.asarray(payload["record"], np.float32)[None]
-                    elif "batch" in payload:
-                        x = np.asarray(payload["batch"], np.float32)
-                    else:
-                        self._send(400, {"error": "need record|record_base64|batch"})
-                        return
-                    out = server.predict(x)
-                    key = "outputs" if "batch" in payload else "output"
-                    val = out.tolist() if "batch" in payload else out[0].tolist()
-                    self._send(200, {key: val})
-                except Exception as e:  # noqa: BLE001 — serving boundary
-                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
-
-            def _do_generate(self):
-                """POST /generate {"tokens": [[ids]], "n_new": K,
-                "temperature"?, "top_k"?, "top_p"?, "seed"?} -> sampled
-                continuation ids. Only models exposing generate() (the
-                transformer flagship; KV-cache decode) serve this route."""
-                try:
-                    payload = self._read_json()
-                    if not hasattr(server.model, "generate"):
-                        self._send(400, {"error": "model has no generate()"})
-                        return
-                    toks = np.asarray(payload["tokens"], np.int32)
-                    if toks.ndim == 1:
-                        toks = toks[None]
-                    # coerce filter args: JSON numbers often arrive as
-                    # floats, and a float top_k would both fail lax.top_k
-                    # and pollute the compile cache key
-                    tk = payload.get("top_k")
-                    tp = payload.get("top_p")
-                    out = server.generate(
-                        toks, int(payload.get("n_new", 16)),
-                        temperature=float(payload.get("temperature", 1.0)),
-                        seed=int(payload.get("seed", 0)),
-                        top_k=int(tk) if tk is not None else None,
-                        top_p=float(tp) if tp is not None else None,
-                    )
-                    self._send(200, {"tokens": out.tolist()})
-                except Exception as e:  # noqa: BLE001 — serving boundary
-                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread: Optional[threading.Thread] = None
-
-    def predict(self, x: np.ndarray) -> np.ndarray:
-        if self.input_shape is not None:
-            x = x.reshape((x.shape[0],) + self.input_shape)
-        with self._lock:  # containers mutate rnn state; serialize access
-            out = self.model.output(x)
-        out0 = out[0] if isinstance(out, (list, tuple)) else out
-        return np.asarray(out0)
-
-    def generate(self, tokens: np.ndarray, n_new: int, **kw) -> np.ndarray:
-        import jax.numpy as jnp
-
-        with self._lock:
-            out = self.model.generate(jnp.asarray(tokens, jnp.int32),
-                                      n_new, **kw)
-        return np.asarray(out)
-
-    # -- lifecycle --------------------------------------------------------
     def start(self) -> "ModelServer":
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
-        )
-        self._thread.start()
+        super().start()
         return self
-
-    def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
-
-    @property
-    def url(self) -> str:
-        return f"http://127.0.0.1:{self.port}"

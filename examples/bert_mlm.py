@@ -31,6 +31,9 @@ SUBJECTS = ["the cat", "a dog", "the bird", "one fish"]
 VERBS = ["sat on", "ran past", "looked at", "slept under"]
 OBJECTS = ["the mat", "a tree", "the fence", "one rock"]
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
 
 def corpus(n: int, rng) -> list:
     return [f"{SUBJECTS[rng.integers(4)]} {VERBS[rng.integers(4)]} "
@@ -67,7 +70,7 @@ def main() -> None:
                      mask_token_id=vocab.index_of(MASK), seed=0)
     lm = BertMLM(cfg)
     first = lm.fit(data[:64])
-    for epoch in range(30):
+    for epoch in range(4 if SMOKE else 30):
         for i in range(0, len(data), 64):
             loss = lm.fit(data[i:i + 64])
         if epoch % 10 == 0:

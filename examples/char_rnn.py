@@ -26,6 +26,9 @@ CORPUS = (
     "peter piper picked a peck of pickled peppers. "
 ) * 40
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
 
 def main():
     chars = sorted(set(CORPUS))
@@ -40,7 +43,7 @@ def main():
     ).init(input_shape=(1, vocab))
 
     rng = np.random.default_rng(0)
-    for step in range(60):
+    for step in range(8 if SMOKE else 60):
         starts = rng.integers(0, len(ids) - seq - 1, batch)
         x = eye[np.stack([ids[s:s + seq] for s in starts])]
         y = eye[np.stack([ids[s + 1:s + seq + 1] for s in starts])]
@@ -53,7 +56,7 @@ def main():
     cur = stoi["t"]
     out = ["t"]
     g = np.random.default_rng(1)
-    for _ in range(120):
+    for _ in range(20 if SMOKE else 120):
         probs = np.asarray(net.rnn_time_step(eye[cur][None, None, :]))[0, 0]
         probs = np.maximum(probs, 0)
         probs /= probs.sum()

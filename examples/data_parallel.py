@@ -9,13 +9,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 import numpy as np
 
 # virtual 8-device CPU mesh (same pattern as tests/conftest.py); on a real
-# TPU slice, delete these two lines and the mesh uses the chips
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+# TPU slice, delete this call and the mesh uses the chips
+from deeplearning4j_tpu.parallel.mesh import virtual_cpu_devices
+
+virtual_cpu_devices(8)
 
 from deeplearning4j_tpu.models.lenet import build_lenet5  # noqa: E402
 from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: E402
@@ -24,24 +24,30 @@ from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: E402
 )
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
+
 def main():
     rng = np.random.default_rng(0)
-    x = rng.random((256, 28, 28, 1)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 256)]
+    n = 64 if SMOKE else 256
+    dp_steps, pa_steps = (2, 2) if SMOKE else (5, 4)
+    x = rng.random((n, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
 
     # GSPMD gradient DP: batch sharded over 8 devices, XLA inserts the psum
     pw = ParallelWrapper(build_lenet5(), num_devices=8)
-    for step in range(5):
+    for step in range(dp_steps):
         loss = float(pw.fit(x, y))
-    print(f"gradient-DP loss after 5 steps: {loss:.4f}")
+    print(f"gradient-DP loss after {dp_steps} steps: {loss:.4f}")
 
     # reference-compatible parameter averaging (Spark master semantics:
     # local steps then params+updater pmean every averaging_frequency)
     pat = ParameterAveragingTrainer(build_lenet5(), num_workers=8,
                                     averaging_frequency=2)
-    for step in range(4):
+    for step in range(pa_steps):
         loss = float(pat.fit(x, y))
-    print(f"param-averaging loss after 4 rounds: {loss:.4f}")
+    print(f"param-averaging loss after {pa_steps} rounds: {loss:.4f}")
 
 
 if __name__ == "__main__":

@@ -32,6 +32,10 @@ from deeplearning4j_tpu.nn.conf import (
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
+
 def main():
     conf = (
         NeuralNetConfiguration.builder()
@@ -54,8 +58,8 @@ def main():
         EarlyStoppingConfiguration.builder()
         .score_calculator(DataSetLossCalculator(val_iter))
         .epoch_termination_conditions(
-            MaxEpochsTerminationCondition(50),
-            ScoreImprovementEpochTerminationCondition(8),
+            MaxEpochsTerminationCondition(6 if SMOKE else 50),
+            ScoreImprovementEpochTerminationCondition(2 if SMOKE else 8),
         )
         .iteration_termination_conditions(
             InvalidScoreIterationTerminationCondition())

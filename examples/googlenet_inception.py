@@ -18,14 +18,19 @@ import numpy as np  # noqa: E402
 from deeplearning4j_tpu.models.googlenet import build_googlenet  # noqa: E402
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
+
 def main():
     rng = np.random.default_rng(0)
-    net = build_googlenet(input_size=64, num_classes=10, aux_heads=True)
+    size, batch = (32, 4) if SMOKE else (64, 8)
+    net = build_googlenet(input_size=size, num_classes=10, aux_heads=True)
     print(f"GoogLeNet (aux heads): {net.num_params()/1e6:.2f}M params, "
           f"{len(net.conf.outputs)} outputs")
-    x = rng.random((8, 64, 64, 3)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
-    for step in range(5):
+    x = rng.random((batch, size, size, 3)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
+    for step in range(2 if SMOKE else 5):
         loss = float(net.fit(x, [y, y, y]))  # main + two aux heads
         print(f"step {step}: summed 3-head loss {loss:.3f}")
     main_out = net.output(x)[0]

@@ -11,10 +11,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+from deeplearning4j_tpu.parallel.mesh import virtual_cpu_devices
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+virtual_cpu_devices(8)
+
+import jax  # noqa: E402
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -28,9 +29,14 @@ from deeplearning4j_tpu.models.transformer import (  # noqa: E402
 )
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SEQ = 128 if SMOKE else 512
+
+
 def main():
     cfg = TransformerConfig(vocab_size=128, d_model=64, n_layers=2,
-                            n_heads=8, d_ff=128, max_len=512)
+                            n_heads=8, d_ff=128, max_len=SEQ)
     params = init_params(cfg)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, cfg.max_len)),
@@ -52,13 +58,14 @@ def main():
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
     cfg_t = TransformerConfig(vocab_size=128, d_model=64, n_layers=2,
-                              n_heads=8, d_ff=128, max_len=512,
+                              n_heads=8, d_ff=128, max_len=SEQ,
                               learning_rate=1e-2, use_flash=False)
     mesh2 = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "seq"))
     lm = TransformerLM(cfg_t, mesh=mesh2)
     targets = jnp.asarray(
         rng.integers(0, cfg_t.vocab_size, tokens.shape), jnp.int32)
-    losses = [float(lm.fit(tokens, targets)) for _ in range(5)]
+    losses = [float(lm.fit(tokens, targets))
+              for _ in range(2 if SMOKE else 5)]
     print(f"SP TRAINING on DPxSP (2x4): loss {losses[0]:.3f} -> "
           f"{losses[-1]:.3f} over {len(losses)} steps")
 

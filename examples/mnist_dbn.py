@@ -21,8 +21,13 @@ from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.models.dbn import build_dbn
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
+
 def main():
-    x, y, provenance = load_mnist_info(train=True, num_examples=1024,
+    x, y, provenance = load_mnist_info(train=True,
+                                       num_examples=256 if SMOKE else 1024,
                                        binarize=True)
     xt, yt, _ = load_mnist_info(train=False, num_examples=256, binarize=True)
     x, xt = x.reshape(len(x), -1), xt.reshape(len(xt), -1)
@@ -35,7 +40,7 @@ def main():
 
     print("fine-tuning...")
     batch = 128
-    for epoch in range(3):
+    for epoch in range(1 if SMOKE else 3):
         losses = [float(net.fit(x[i:i + batch], y[i:i + batch]))
                   for i in range(0, len(x), batch)]
         print(f"epoch {epoch}: mean loss {np.mean(losses):.4f}")

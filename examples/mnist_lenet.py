@@ -24,14 +24,21 @@ from deeplearning4j_tpu.models.lenet import build_lenet5
 from deeplearning4j_tpu.utils.serialization import ModelSerializer
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py):
+# the stock flow unchanged, just fewer examples/epochs so 11 entrypoints
+# finish in minutes on the 1-core CPU host
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
+
 def main():
     net = build_lenet5()
-    x, y, provenance = load_mnist_info(train=True, num_examples=2048)
+    x, y, provenance = load_mnist_info(train=True,
+                                       num_examples=512 if SMOKE else 2048)
     xt, yt, _ = load_mnist_info(train=False, num_examples=512)
     print(f"data: {provenance}; train {x.shape}, test {xt.shape}")
 
     batch = 256
-    for epoch in range(3):
+    for epoch in range(1 if SMOKE else 3):
         perm = np.random.default_rng(epoch).permutation(len(x))
         losses = []
         for i in range(0, len(x), batch):

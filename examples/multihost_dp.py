@@ -37,8 +37,9 @@ N_PROCESSES = 2
 def worker() -> None:
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from deeplearning4j_tpu.parallel.mesh import virtual_cpu_devices
+
+    virtual_cpu_devices(2)
 
     import numpy as np
     from jax.sharding import Mesh
@@ -53,6 +54,21 @@ def worker() -> None:
     print(f"[proc {info['process_index']}] sees "
           f"{info['local_device_count']} local / "
           f"{info['global_device_count']} global devices", flush=True)
+
+    # capability probe (same filter as tests/multihost_worker.py): some
+    # jaxlib builds cannot run multi-process computations on the CPU
+    # backend — exit cleanly there instead of crashing the stock example;
+    # any OTHER collective failure stays loud
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("example_probe")
+    except Exception as e:  # noqa: BLE001 — filtered to the capability case
+        if "Multiprocess computations" not in str(e):
+            raise
+        print(f"[proc {info['process_index']}] MH_SKIP multiprocess CPU "
+              f"collectives unavailable in this jaxlib: {e}", flush=True)
+        return
 
     def build():
         conf = (

@@ -9,11 +9,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 import numpy as np
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from deeplearning4j_tpu.parallel.mesh import virtual_cpu_devices
+
+virtual_cpu_devices(8)
 
 import jax.numpy as jnp  # noqa: E402
 
@@ -25,6 +25,9 @@ from deeplearning4j_tpu.parallel.mesh import device_mesh  # noqa: E402
 
 TEXT = ("to be or not to be that is the question "
         "whether tis nobler in the mind to suffer ") * 60
+
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
 
 
 def main():
@@ -40,7 +43,7 @@ def main():
 
     rng = np.random.default_rng(0)
     batch, seq = 8, cfg.max_len
-    for step in range(40):
+    for step in range(6 if SMOKE else 40):
         starts = rng.integers(0, len(ids) - seq - 1, batch)
         x = jnp.asarray(np.stack([ids[s:s + seq] for s in starts]))
         y = jnp.asarray(np.stack([ids[s + 1:s + seq + 1] for s in starts]))
@@ -50,8 +53,8 @@ def main():
 
     prompt = jnp.asarray([[stoi[c] for c in "to be "]], jnp.int32)
     # KV-cache decoding (default), nucleus sampling: O(max_len) per token
-    out = lm.generate(prompt, n_new=40, temperature=0.8, seed=0,
-                      top_k=min(50, cfg.vocab_size), top_p=0.95)
+    out = lm.generate(prompt, n_new=8 if SMOKE else 40, temperature=0.8,
+                      seed=0, top_k=min(50, cfg.vocab_size), top_p=0.95)
     print("sample:", "to be " + "".join(chars[int(i)] for i in out[0]))
 
 

@@ -29,9 +29,13 @@ SENTENCES = [
 ] * 30
 
 
+# tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
+SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+
+
 def main():
     w2v = Word2Vec(layer_size=48, window=4, negative=5, min_word_frequency=3,
-                   epochs=8, seed=42)
+                   epochs=2 if SMOKE else 8, seed=42)
     w2v.fit(SENTENCES)
     for word in ("king", "dog", "day"):
         print(f"nearest to '{word}':", w2v.words_nearest(word, 4))

@@ -11,6 +11,14 @@ The watcher uses this to decide whether another pass is still needed after
 a tunnel outage ate part of a run (round-4: the 03:47 contact lasted ~3
 minutes and the single-shot watcher would have stopped watching after one
 all-error pass).
+
+It also WARNS (without failing) when the merged artifact mixes
+measurement conditions (VERDICT r5 weak #9 — the Spark stats timeline
+role, dl4j-spark/.../stats/StatsUtils.java:65): rows spanning more than
+MAX_SPAN_HOURS (a multi-window capture under different tunnel/host
+states), or rows whose recorded 1-minute load averages (`load1`, stamped
+by bench.py per leg) differ by more than MAX_LOAD_SPREAD — a quiet-host
+row and a contended-host row must not be read as one regime.
 """
 import json
 import os
@@ -23,9 +31,13 @@ EXPECTED = [
     "mxu_calibration", "lenet5", "lenet5_fused", "dispatch_overhead",
     "char_rnn", "word2vec_sgns", "transformer_lm", "resnet50",
     "resnet50_bf16", "transformer_lm_big", "flash_attention",
-    "ring_attention", "lstm_kernel", "north_star",
-    "reference_cpu_lenet5_torch", "native_feed", "scaling_virtual8",
+    "ring_attention", "lstm_kernel", "north_star", "serving_throughput",
+    "reference_cpu_lenet5_torch", "lenet5_cpu", "char_rnn_cpu",
+    "native_feed", "scaling_virtual8",
 ]
+
+MAX_SPAN_HOURS = 6.0
+MAX_LOAD_SPREAD = 1.5
 
 _BENCH_PY = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "bench.py")
@@ -57,13 +69,57 @@ def gaps(legs: dict) -> list:
     return out
 
 
+def _parse_ts(s):
+    import time
+
+    try:
+        return time.mktime(time.strptime(s, "%Y-%m-%dT%H:%M:%S"))
+    except (TypeError, ValueError):
+        return None
+
+
+def warnings(legs: dict) -> list:
+    """Cross-row condition-skew flags for a merged multi-pass artifact.
+    Warnings never change the exit code — a complete artifact is complete
+    — but a summarizer quoting rows hours (or load regimes) apart should
+    say so."""
+    out = []
+    stamped = [(name, row) for name, row in legs.items()
+               if isinstance(row, dict) and "error" not in row]
+    times = [(name, _parse_ts(row.get("ts"))) for name, row in stamped]
+    times = [(n, t) for n, t in times if t is not None]
+    if len(times) >= 2:
+        lo = min(times, key=lambda p: p[1])
+        hi = max(times, key=lambda p: p[1])
+        span_h = (hi[1] - lo[1]) / 3600.0
+        if span_h > MAX_SPAN_HOURS:
+            out.append(
+                f"rows span {span_h:.1f}h (oldest {lo[0]}, newest {hi[0]})"
+                f" > {MAX_SPAN_HOURS:.0f}h — mixed capture windows; treat"
+                " cross-leg comparisons with care")
+    loads = [(name, row.get("load1")) for name, row in stamped]
+    loads = [(n, float(l)) for n, l in loads if isinstance(l, (int, float))]
+    if len(loads) >= 2:
+        lo = min(loads, key=lambda p: p[1])
+        hi = max(loads, key=lambda p: p[1])
+        if hi[1] - lo[1] > MAX_LOAD_SPREAD:
+            out.append(
+                f"host-load regimes differ: load1 {lo[1]:.2f} ({lo[0]}) vs"
+                f" {hi[1]:.2f} ({hi[0]}), spread > {MAX_LOAD_SPREAD} — "
+                "rows were measured under different contention")
+    return out
+
+
 def main() -> int:
     path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_PARTIAL.json"
     try:
-        missing = gaps(legs_of(path))
+        legs = legs_of(path)
+        missing = gaps(legs)
     except (OSError, ValueError) as e:
         print(f"unreadable {path}: {e}")
         return 1
+    for w in warnings(legs):
+        print("WARN:", w)
     if missing:
         print("missing/errored legs:", ", ".join(missing))
         return 1

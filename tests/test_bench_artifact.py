@@ -102,6 +102,52 @@ def test_bench_state_checker(tmp_path):
     assert _run_state(p).returncode == 0
 
 
+def test_bench_state_warns_on_time_skew(tmp_path):
+    """Rows measured >6h apart (a multi-window capture) get a WARN line
+    without changing the completeness verdict (VERDICT r5 ask #9)."""
+    from scripts.bench_state import EXPECTED
+
+    legs = {name: {"x": 1.0, "ts": "2026-08-04T01:00:00"}
+            for name in EXPECTED}
+    legs["lenet5"]["ts"] = "2026-08-04T09:30:00"  # 8.5h after the rest
+    p = tmp_path / "partial.json"
+    p.write_text(json.dumps({"legs": legs}))
+    r = _run_state(p)
+    assert r.returncode == 0  # complete — warnings don't fail
+    assert "WARN:" in r.stdout and "span" in r.stdout
+    assert "lenet5" in r.stdout
+
+
+def test_bench_state_warns_on_load_regime_skew(tmp_path):
+    from scripts.bench_state import EXPECTED
+
+    legs = {name: {"x": 1.0, "load1": 0.2} for name in EXPECTED}
+    legs["resnet50"]["load1"] = 3.4  # contended-host row among quiet rows
+    p = tmp_path / "partial.json"
+    p.write_text(json.dumps({"legs": legs}))
+    r = _run_state(p)
+    assert r.returncode == 0
+    assert "WARN:" in r.stdout and "load1" in r.stdout
+    assert "resnet50" in r.stdout
+
+
+def test_bench_state_quiet_when_conditions_match(tmp_path):
+    from scripts.bench_state import EXPECTED
+
+    legs = {name: {"x": 1.0, "ts": "2026-08-04T01:00:00", "load1": 0.5}
+            for name in EXPECTED}
+    p = tmp_path / "partial.json"
+    p.write_text(json.dumps({"legs": legs}))
+    r = _run_state(p)
+    assert r.returncode == 0 and "WARN" not in r.stdout
+    # error rows are excluded from skew analysis (their ts is outage
+    # bookkeeping, not a measurement condition)
+    legs["north_star"] = {"error": "down", "ts": "2026-08-05T23:00:00"}
+    p.write_text(json.dumps({"legs": legs}))
+    r = _run_state(p)
+    assert r.returncode == 1 and "WARN" not in r.stdout
+
+
 def test_bench_state_expected_matches_bench_legs():
     """Three-way pin: an INDEPENDENT parse of bench.py's run() calls must
     be non-empty (else the checker's regex broke and expected_legs() is

