@@ -155,7 +155,17 @@ class MultiDataSet:
 
 class DataSetIterator:
     """Iterator protocol. Python iteration + reset(), matching the reference's
-    hasNext/next/reset surface."""
+    hasNext/next/reset surface.
+
+    Resumable-iterator protocol (resilience/): ``state()`` returns a
+    JSON-able cursor describing how many batches the CURRENT pass has
+    yielded (plus iterator-specific extras like the sampling RNG state);
+    ``restore_state(s)`` arranges the NEXT pass to continue from that
+    cursor — a one-shot skip, so ordinary iteration (and the reference's
+    hasNext/next/reset contract) is bit-identical when the protocol is
+    unused. The reference has no analogue: its fault story replays whole
+    RDD partitions through Spark lineage; here the cursor makes a resumed
+    ``fit`` replay the EXACT remaining batch stream."""
 
     def __iter__(self) -> Iterator[DataSet]:
         raise NotImplementedError
@@ -168,6 +178,16 @@ class DataSetIterator:
 
     def total_examples(self) -> int:
         raise NotImplementedError
+
+    def state(self) -> Optional[dict]:
+        """JSON-able resume cursor, or None when this iterator cannot be
+        resumed exactly (the resilience trainer then warns that a
+        mid-epoch resume would replay the epoch from its start)."""
+        return None
+
+    def restore_state(self, state: dict) -> None:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support exact resume")
 
 
 class ListDataSetIterator(DataSetIterator):
@@ -192,13 +212,21 @@ class ListDataSetIterator(DataSetIterator):
         self.label_masks = None if label_masks is None else np.asarray(label_masks)
         self._batch = int(batch)
         self.drop_partial = drop_partial
+        self._cursor = 0       # batches yielded in the current pass
+        self._resume_skip = 0  # one-shot start offset (restore_state)
 
     def __iter__(self):
+        start, self._resume_skip = self._resume_skip, 0
+        self._cursor = start
         n = self.features.shape[0]
-        for i in range(0, n, self._batch):
+        for i in range(start * self._batch, n, self._batch):
             if self.drop_partial and i + self._batch > n:
                 break
             sl = slice(i, min(i + self._batch, n))
+            # cursor advances BEFORE the yield: while the consumer holds
+            # batch j, state() already reads j+1 — a checkpoint taken
+            # after fitting that batch resumes at the right place
+            self._cursor += 1
             yield DataSet(
                 self.features[sl],
                 self.labels[sl],
@@ -206,11 +234,22 @@ class ListDataSetIterator(DataSetIterator):
                 None if self.label_masks is None else self.label_masks[sl],
             )
 
+    def reset(self):
+        self._cursor = 0
+        self._resume_skip = 0
+
     def batch_size(self):
         return self._batch
 
     def total_examples(self):
         return int(self.features.shape[0])
+
+    def state(self):
+        return {"cursor": self._cursor}
+
+    def restore_state(self, state):
+        self._resume_skip = int(state["cursor"])
+        self._cursor = self._resume_skip
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -224,6 +263,13 @@ class AsyncDataSetIterator(DataSetIterator):
         self.base = base
         self.queue_size = max(1, int(queue_size))
         self.device_put = device_put
+        # resume cursor of the batch most recently DELIVERED to the
+        # consumer — NOT base.state(), which runs ahead by however many
+        # batches sit prefetched in the queue (those would be silently
+        # skipped on resume). The producer snapshots base.state() right
+        # after pulling each batch and the snapshot rides the queue with
+        # its batch.
+        self._last_state: Optional[dict] = None
 
     def _put(self, q: "queue.Queue", stop: threading.Event, item) -> bool:
         """Bounded put that gives up when the consumer abandoned iteration
@@ -241,6 +287,9 @@ class AsyncDataSetIterator(DataSetIterator):
             for ds in self.base:
                 if stop.is_set():
                     return
+                # resume snapshot for THIS batch (base only ever touched
+                # from this thread, so the read is race-free)
+                snap = self.base.state()
                 if self.device_put:
                     ds = DataSet(
                         jax.device_put(ds.features),
@@ -252,12 +301,15 @@ class AsyncDataSetIterator(DataSetIterator):
                         if ds.labels_mask is None
                         else jax.device_put(ds.labels_mask),
                     )
-                if not self._put(q, stop, ds):
+                if not self._put(q, stop, (ds, snap)):
                     return
         finally:
             self._put(q, stop, self._SENTINEL)
 
     def __iter__(self):
+        # before any batch is delivered, the resume point is wherever the
+        # base stands now (fresh pass or a restored cursor)
+        self._last_state = self.base.state()
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
         stop = threading.Event()
         t = threading.Thread(target=self._producer, args=(q, stop), daemon=True)
@@ -267,12 +319,15 @@ class AsyncDataSetIterator(DataSetIterator):
                 item = q.get()
                 if item is self._SENTINEL:
                     break
-                yield item
+                ds, snap = item
+                self._last_state = snap
+                yield ds
         finally:
             stop.set()
             t.join(timeout=5.0)
 
     def reset(self):
+        self._last_state = None
         self.base.reset()
 
     def batch_size(self):
@@ -281,6 +336,14 @@ class AsyncDataSetIterator(DataSetIterator):
     def total_examples(self):
         return self.base.total_examples()
 
+    def state(self):
+        return (self._last_state if self._last_state is not None
+                else self.base.state())
+
+    def restore_state(self, state):
+        self.base.restore_state(state)
+        self._last_state = dict(state)
+
 
 class MultipleEpochsIterator(DataSetIterator):
     """Repeat a base iterator N epochs (reference MultipleEpochsIterator)."""
@@ -288,13 +351,24 @@ class MultipleEpochsIterator(DataSetIterator):
     def __init__(self, num_epochs: int, base: DataSetIterator):
         self.num_epochs = int(num_epochs)
         self.base = base
+        self._epoch = 0
+        self._resume: Optional[dict] = None
 
     def __iter__(self):
-        for _ in range(self.num_epochs):
+        resume, self._resume = self._resume, None
+        start = 0
+        if resume is not None:
+            start = int(resume.get("epoch", 0))
+            if resume.get("base") is not None:
+                self.base.restore_state(resume["base"])
+        for ep in range(start, self.num_epochs):
+            self._epoch = ep
             yield from self.base
             self.base.reset()
 
     def reset(self):
+        self._epoch = 0
+        self._resume = None
         self.base.reset()
 
     def batch_size(self):
@@ -302,6 +376,16 @@ class MultipleEpochsIterator(DataSetIterator):
 
     def total_examples(self):
         return self.base.total_examples() * self.num_epochs
+
+    def state(self):
+        base = self.base.state()
+        if base is None:
+            return None
+        return {"epoch": self._epoch, "base": base}
+
+    def restore_state(self, state):
+        self._resume = dict(state)
+        self._epoch = int(state.get("epoch", 0))
 
 
 class SamplingDataSetIterator(DataSetIterator):
@@ -313,11 +397,16 @@ class SamplingDataSetIterator(DataSetIterator):
         self._batch = int(batch)
         self.total_batches = int(total_batches)
         self._rng = np.random.default_rng(seed)
+        self._cursor = 0
+        self._resume_skip = 0
 
     def __iter__(self):
+        start, self._resume_skip = self._resume_skip, 0
+        self._cursor = start
         n = self.features.shape[0]
-        for _ in range(self.total_batches):
+        for _ in range(start, self.total_batches):
             idx = self._rng.integers(0, n, size=self._batch)
+            self._cursor += 1
             yield DataSet(self.features[idx], self.labels[idx])
 
     def batch_size(self):
@@ -325,3 +414,17 @@ class SamplingDataSetIterator(DataSetIterator):
 
     def total_examples(self):
         return self._batch * self.total_batches
+
+    def state(self):
+        # the bit-generator state (plain ints, JSON-safe) makes the resume
+        # exact even though each batch consumes a draw: restoring replays
+        # the identical remaining index stream
+        return {"cursor": self._cursor,
+                "rng_state": self._rng.bit_generator.state}
+
+    def restore_state(self, state):
+        self._resume_skip = int(state["cursor"])
+        self._cursor = self._resume_skip
+        if state.get("rng_state") is not None:
+            self._rng = np.random.default_rng()
+            self._rng.bit_generator.state = state["rng_state"]

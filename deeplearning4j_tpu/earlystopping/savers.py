@@ -2,7 +2,12 @@
 
 InMemoryModelSaver keeps clones in RAM; LocalFileModelSaver writes
 bestModel/latestModel checkpoints via ModelSerializer (reference
-LocalFileModelSaver.java writes bestModel.bin / latestModel.bin).
+LocalFileModelSaver.java writes bestModel.bin / latestModel.bin — with a
+bare FileOutputStream, so a crash mid-save tears the file). Here every
+file save routes through the resilience plane's crash-safe writer
+(resilience/checkpoint.atomic_replace: tmp + fsync + rename), and
+``CheckpointManagerSaver`` layers the full manager (async, digested,
+retained, corruption-fallback) under the early-stopping contract.
 """
 
 from __future__ import annotations
@@ -48,15 +53,28 @@ class LocalFileModelSaver:
     def latest_path(self) -> str:
         return os.path.join(self.directory, "latestModel.zip")
 
-    def save_best_model(self, net, score: float) -> None:
+    @staticmethod
+    def _atomic_write(net, path: str) -> None:
+        """Crash-safe save: serialize straight to a tmp FILE (not an
+        in-memory buffer — a multi-GB model would double its host
+        footprint), fsync, then rename — the previous
+        bestModel/latestModel survives any mid-save death."""
+        from deeplearning4j_tpu.resilience.checkpoint import fsync_file
         from deeplearning4j_tpu.utils.serialization import ModelSerializer
 
-        ModelSerializer.write_model(net, self.best_path)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        ModelSerializer.write_model(net, tmp,
+                                    training_state=net.training_state()
+                                    if hasattr(net, "training_state")
+                                    else None)
+        fsync_file(tmp)
+        os.replace(tmp, path)
+
+    def save_best_model(self, net, score: float) -> None:
+        self._atomic_write(net, self.best_path)
 
     def save_latest_model(self, net, score: float) -> None:
-        from deeplearning4j_tpu.utils.serialization import ModelSerializer
-
-        ModelSerializer.write_model(net, self.latest_path)
+        self._atomic_write(net, self.latest_path)
 
     def get_best_model(self):
         from deeplearning4j_tpu.utils.serialization import ModelSerializer
@@ -71,3 +89,63 @@ class LocalFileModelSaver:
         if not os.path.exists(self.latest_path):
             return None
         return ModelSerializer.restore(self.latest_path)
+
+
+class CheckpointManagerSaver:
+    """Early-stopping saver backed by the resilience CheckpointManager:
+    'latest' saves become managed checkpoints (async write, sha256
+    manifest, keep-last-k retention, corrupt-checkpoint fallback on
+    load), while 'best' stays a pinned atomic zip that retention can
+    never prune — the reference saver contract
+    (LocalFileModelSaver.java) on top of the production checkpoint
+    plane."""
+
+    def __init__(self, directory: str, manager: Optional[object] = None,
+                 keep_last: int = 3):
+        from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.manager = manager or CheckpointManager(
+            os.path.join(directory, "latest"), keep_last=keep_last)
+        if getattr(self.manager, "backend", "zip") != "zip":
+            # get_latest_model reconstructs a standalone net from the
+            # model.zip payload; the sharded layout restores INTO an
+            # existing template, which this saver has no way to build
+            raise ValueError(
+                "CheckpointManagerSaver requires a zip-backend "
+                "CheckpointManager (sharded payloads restore into an "
+                "existing net via CheckpointManager.restore)")
+        # continue the step chain across process restarts: starting back
+        # at 0 would hand retention a checkpoint older than the keep set
+        # (pruned on the spot) and leave get_latest_model stale
+        self._saves = max(
+            (s for s, _ in self.manager.checkpoints()), default=0)
+
+    @property
+    def best_path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    def save_best_model(self, net, score: float) -> None:
+        LocalFileModelSaver._atomic_write(net, self.best_path)
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._saves += 1
+        self.manager.save(net, step=self._saves)
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        if not os.path.exists(self.best_path):
+            return None
+        return ModelSerializer.restore(self.best_path)
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        self.manager.flush()
+        found = self.manager.latest_intact()
+        if found is None:
+            return None
+        path, _ = found
+        return ModelSerializer.restore(os.path.join(path, "model.zip"))

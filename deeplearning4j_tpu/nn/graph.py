@@ -969,6 +969,20 @@ class ComputationGraph:
             for n, s in self.updater_state.items()
         }
 
+    def training_state(self) -> Dict[str, Any]:
+        """Exact-resume extras (see MultiLayerNetwork.training_state —
+        same contract for the DAG container)."""
+        return {
+            "iteration": int(self.iteration),
+            "rng": np.asarray(self._rng, np.uint32).tolist(),
+        }
+
+    def restore_training_state(self, st: Dict[str, Any]) -> None:
+        if st.get("iteration") is not None:
+            self.iteration = int(st["iteration"])
+        if st.get("rng") is not None:
+            self._rng = jnp.asarray(np.asarray(st["rng"], dtype=np.uint32))
+
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 

@@ -882,6 +882,27 @@ class MultiLayerNetwork:
             decay_lr_scale_entry(s, rate) for s in self.updater_state
         ]
 
+    # ------------------------------------------------------------ resilience
+    def training_state(self) -> Dict[str, Any]:
+        """Everything beyond params/states/updater that exact resume needs
+        (resilience/checkpoint.py): the iteration counter (the per-step RNG
+        stream and every LR schedule fold it in) and the base RNG key. The
+        reference's ModelSerializer drops both (ModelSerializer.java:70-110
+        writes config+coefficients+updater only), which is why a restored
+        reference run drifts from the uninterrupted one."""
+        return {
+            "iteration": int(self.iteration),
+            "rng": np.asarray(self._rng, np.uint32).tolist(),
+        }
+
+    def restore_training_state(self, st: Dict[str, Any]) -> None:
+        """Inverse of :meth:`training_state`; tolerant of partial dicts so
+        pre-resilience checkpoints (no rng section) keep loading."""
+        if st.get("iteration") is not None:
+            self.iteration = int(st["iteration"])
+        if st.get("rng") is not None:
+            self._rng = jnp.asarray(np.asarray(st["rng"], dtype=np.uint32))
+
     # ------------------------------------------------------------- listeners
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

@@ -84,6 +84,34 @@ def is_multihost() -> bool:
     return jax.process_count() > 1
 
 
+def is_primary() -> bool:
+    """True on the process that should own shared-filesystem writes —
+    checkpoint payloads, manifests, and retention deletes
+    (resilience/checkpoint.py): N processes writing the same manager
+    directory would race the atomic renames. Env-first so the query NEVER
+    initializes a backend (the dead-tunnel rule: jax.process_index()
+    would initialize the axon plugin and hang); an unconfigured
+    single-process run is always primary."""
+    pid = _int_env(PROCESS_ID_ENV)
+    if pid is not None:
+        return pid == 0
+    try:
+        # private probe (same one __graft_entry__ uses): ONLY safe way to
+        # ask "is a backend up" without initializing one
+        from jax._src import xla_bridge as _xb
+
+        initialized = _xb.backends_are_initialized()
+    except Exception:  # jax moved the symbol: fall through to the query —
+        # every caller (CheckpointManager.save) runs after training steps
+        # have already initialized the backend, so this cannot hang
+        initialized = True
+    if initialized:
+        import jax
+
+        return jax.process_index() == 0
+    return True
+
+
 def process_info() -> dict:
     import jax
 

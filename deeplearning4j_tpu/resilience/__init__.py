@@ -1,0 +1,24 @@
+"""Fault-tolerant training runtime.
+
+The resilience analogue of the repo's distributed==serial convention:
+interrupted-and-resumed training == uninterrupted training, proven under
+deterministically injected faults. See checkpoint.py (async atomic
+CheckpointManager), trainer.py (ResilientTrainer: preemption +
+restore-and-continue + retry), chaos.py (the fault-injection harness the
+tests drive — never ambient).
+"""
+
+from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
+    ChaosConfig,
+    ChaosMonkey,
+    InjectedKill,
+    TransientDeviceError,
+)
+from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401
+    CheckpointCorrupt,
+    CheckpointManager,
+)
+from deeplearning4j_tpu.resilience.trainer import (  # noqa: F401
+    Preempted,
+    ResilientTrainer,
+)

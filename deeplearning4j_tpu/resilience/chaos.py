@@ -1,0 +1,160 @@
+"""Deterministic fault injection for the fault-tolerant training runtime.
+
+The reference survives worker loss through Spark lineage plus the
+StateTracker heartbeat/reclaim plane (ConnectionStateTracker heartbeats,
+reproduced in parallel/statetracker.py) — but it has no way to *provoke*
+those failures deterministically, so its resilience paths were exercised
+only by real cluster flakiness. This module is the missing test
+instrument: every fault the resilience/ subsystem claims to survive
+(process kill at a known step, SIGTERM preemption, a stalled feed, a
+truncated or bit-flipped checkpoint, a transient device error) can be
+injected at an exact, reproducible point, driven ONLY by an explicit
+:class:`ChaosConfig` — there is no ambient/env activation, so a run
+without a configured monkey is bit-identical to a run without this
+module imported (the zero-behavior-change contract in
+tests/test_resilience.py).
+
+Faults and where they fire:
+
+  kill_at_step        — after step k completes: raise :class:`InjectedKill`
+                        (``kill_mode="exception"``, a hard crash with NO
+                        goodbye checkpoint) or deliver a real SIGTERM to
+                        this process (``kill_mode="sigterm"``, exercising
+                        the trainer's checkpoint-before-death path).
+  stall_at_step       — before step k: sleep ``stall_seconds`` (a wedged
+                        feed/tunnel; liveness, not correctness).
+  transient_error_at_step — before step k: raise
+                        :class:`TransientDeviceError` the first
+                        ``transient_error_count`` times, then succeed
+                        (the retry/backoff path in ResilientTrainer).
+  corrupt_checkpoint  — after the manager commits checkpoint step k:
+                        truncate or bit-flip its payload on disk
+                        (the corruption-detection/fallback path in
+                        CheckpointManager.latest_intact).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+class InjectedKill(RuntimeError):
+    """A chaos-injected hard crash (no cleanup, no goodbye checkpoint)."""
+
+
+class TransientDeviceError(RuntimeError):
+    """A chaos-injected transient accelerator failure (retryable)."""
+
+
+@dataclass
+class ChaosConfig:
+    """Declarative fault plan. Steps are 1-based counts of COMPLETED
+    trainer steps (kill_at_step=k dies after the k-th step's update has
+    been applied; stall/transient fire before the step runs)."""
+
+    kill_at_step: Optional[int] = None
+    kill_mode: str = "exception"  # "exception" | "sigterm"
+    stall_at_step: Optional[int] = None
+    stall_seconds: float = 0.0
+    transient_error_at_step: Optional[int] = None
+    transient_error_count: int = 1
+    # {"at_step": int, "mode": "truncate"|"bitflip"} applied to the
+    # checkpoint the manager just committed for that step
+    corrupt_checkpoint: Optional[dict] = None
+
+    def __post_init__(self):
+        if self.kill_mode not in ("exception", "sigterm"):
+            raise ValueError(f"unknown kill_mode {self.kill_mode!r}")
+        if self.corrupt_checkpoint is not None:
+            mode = self.corrupt_checkpoint.get("mode", "truncate")
+            if mode not in ("truncate", "bitflip"):
+                raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+class ChaosMonkey:
+    """Stateful executor of a :class:`ChaosConfig`, consulted by
+    ResilientTrainer (before/after each step) and CheckpointManager
+    (after each committed checkpoint). Deterministic: the same config
+    against the same step sequence injects the same faults."""
+
+    def __init__(self, config: ChaosConfig):
+        if isinstance(config, dict):
+            config = ChaosConfig(**config)
+        self.config = config
+        self._transient_left = int(config.transient_error_count)
+        self.log: list = []  # (step, fault) audit trail for tests
+
+    # ------------------------------------------------------------ step hooks
+    def before_step(self, step: int) -> None:
+        """`step` is the 1-based index of the step ABOUT to run."""
+        c = self.config
+        if c.stall_at_step is not None and step == c.stall_at_step:
+            self.log.append((step, "stall"))
+            time.sleep(c.stall_seconds)
+        if (c.transient_error_at_step is not None
+                and step == c.transient_error_at_step
+                and self._transient_left > 0):
+            self._transient_left -= 1
+            self.log.append((step, "transient_error"))
+            raise TransientDeviceError(
+                f"injected transient device error at step {step} "
+                f"({self._transient_left} more before recovery)")
+
+    def after_step(self, step: int) -> None:
+        """`step` is the 1-based count of COMPLETED steps."""
+        c = self.config
+        if c.kill_at_step is not None and step == c.kill_at_step:
+            self.log.append((step, f"kill:{c.kill_mode}"))
+            if c.kill_mode == "sigterm":
+                # a REAL signal, exactly like a preempting scheduler: the
+                # trainer's handler sets the flag and the loop performs
+                # checkpoint-before-death at the next boundary
+                os.kill(os.getpid(), signal.SIGTERM)
+                return
+            raise InjectedKill(f"injected kill after step {step}")
+
+    # ------------------------------------------------- checkpoint corruption
+    def on_checkpoint_written(self, path: str, step: int) -> None:
+        """Called by CheckpointManager after committing `path` for `step`."""
+        c = self.config.corrupt_checkpoint
+        if c is None or int(c.get("at_step", -1)) != step:
+            return
+        target = os.path.join(path, "model.zip")
+        if not os.path.exists(target):  # sharded layout: hit any payload
+            for root, _, files in os.walk(path):
+                for f in files:
+                    if f != "MANIFEST.json":
+                        target = os.path.join(root, f)
+                        break
+        mode = c.get("mode", "truncate")
+        self.log.append((step, f"corrupt:{mode}"))
+        if mode == "truncate":
+            truncate_file(target, keep=int(c.get("keep_bytes", 16)))
+        else:
+            bitflip_file(target, offset=c.get("at_byte"))
+
+
+def truncate_file(path: str, keep: int = 16) -> None:
+    """Write-then-truncate fault: keep only the first `keep` bytes (a
+    crash mid-write that an atomic rename would normally prevent —
+    simulates torn storage underneath the checkpoint)."""
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+
+
+def bitflip_file(path: str, offset: Optional[int] = None) -> None:
+    """Flip one bit of `path` in place (silent media corruption). With no
+    offset the middle byte is flipped — deterministic, no RNG."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    off = size // 2 if offset is None else int(offset) % size
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x01]))

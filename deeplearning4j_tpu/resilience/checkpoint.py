@@ -1,0 +1,548 @@
+"""Async atomic checkpoint manager with retention, digests, and fallback.
+
+The reference persists models through ModelSerializer and the
+early-stopping savers (LocalFileModelSaver.java writes bestModel.bin /
+latestModel.bin with a bare FileOutputStream — a crash mid-write leaves a
+torn file, and nothing ever verifies a checkpoint before trusting it).
+This module is the production-grade replacement the ROADMAP's
+"handles as many scenarios as you can imagine" bar demands:
+
+  * **Async**: ``save()`` snapshots the training state to HOST numpy
+    synchronously (mandatory — under buffer donation the next train step
+    CONSUMES the device buffers a lazy writer would still be reading) and
+    hands serialization + IO to a background worker, so the train loop
+    stalls for the snapshot only, not the zip/fsync.
+  * **Atomic**: payload is written into ``ckpt-<step>.tmp/``, fsync'd,
+    manifested, then committed with one directory rename — a preemption
+    at any instant leaves either the previous checkpoint or the new one,
+    never a torn mix (same discipline as utils/sharded_checkpoint.py's
+    pointer-file flip).
+  * **Verified**: MANIFEST.json records a sha256 per payload file;
+    ``latest_intact()`` re-hashes before trusting, logs and SKIPS a
+    corrupt checkpoint, and falls back to the newest intact one — a
+    bit-flip or truncation can cost retained history, never a silent
+    garbage restore.
+  * **Retention**: keep-last-k plus keep-every-n anchors
+    (``DL4J_TPU_CKPT_KEEP``), pruned only after a successful commit.
+  * **Layered**: the payload is either the single-host ModelSerializer
+    zip (utils/serialization.py — now with the training-state section) or
+    the orbax sharded layout (utils/sharded_checkpoint.py) for
+    mesh-sharded state, behind one manifest/retention/fallback plane.
+
+Scheduling: ``should_save(step)`` implements step-cadence
+(``every_steps`` / ``DL4J_TPU_CKPT_EVERY``) and wall-clock cadence
+(``every_seconds``). Multi-process runs write from the primary process
+only (parallel/multihost.is_primary); every process restores from the
+shared directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import queue
+import shutil
+import threading
+import time
+import zipfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+ENV_EVERY = "DL4J_TPU_CKPT_EVERY"
+ENV_KEEP = "DL4J_TPU_CKPT_KEEP"
+ENV_ASYNC = "DL4J_TPU_CKPT_ASYNC"
+
+MANIFEST = "MANIFEST.json"
+MANIFEST_FORMAT = 1
+_CKPT_PREFIX = "ckpt-"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed digest/structure verification (raised only by
+    the explicit single-checkpoint restore path; the scanning restore
+    logs and falls back instead)."""
+
+
+# --------------------------------------------------------------------- utils
+def fsync_file(path: str) -> None:
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def atomic_replace(path: str, data: bytes) -> None:
+    """Crash-safe single-file write: tmp + fsync + rename (the
+    early-stopping savers route their bestModel/latestModel zips through
+    this so a preemption mid-save can no longer tear them)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _host_tree(tree):
+    """Numpy copies of every leaf — the synchronous part of an async save.
+    np.asarray on a jax array devices-to-host copies; doing it HERE (not
+    in the worker) is what makes async checkpointing sound under buffer
+    donation: by the time the next train step consumes the donated
+    buffers, the snapshot no longer references them."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    try:
+        return int(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+@dataclass
+class _SaveJob:
+    step: int
+    model_class: str
+    conf_json: str
+    params: Any
+    states: Any
+    updater_state: Any
+    meta: Dict[str, Any]
+    training_state: Dict[str, Any]
+    done: threading.Event = field(default_factory=threading.Event)
+    error: Optional[BaseException] = None
+    path: Optional[str] = None
+
+
+class CheckpointManager:
+    """See module docstring. One manager owns one checkpoint directory."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        every_steps: Optional[int] = None,
+        every_seconds: Optional[float] = None,
+        keep_last: Optional[int] = None,
+        keep_every: Optional[int] = None,
+        async_save: Optional[bool] = None,
+        backend: str = "zip",
+        compression: int = zipfile.ZIP_STORED,
+        primary: Optional[bool] = None,
+        chaos=None,
+    ):
+        if backend not in ("zip", "sharded"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        self.directory = os.path.abspath(directory)
+        self.every_steps = (_env_int(ENV_EVERY, 0) if every_steps is None
+                            else int(every_steps))
+        self.every_seconds = every_seconds
+        self.keep_last = (_env_int(ENV_KEEP, 3) if keep_last is None
+                          else int(keep_last))
+        self.keep_every = keep_every
+        self.async_save = (os.environ.get(ENV_ASYNC, "1") != "0"
+                           if async_save is None else bool(async_save))
+        self.backend = backend
+        self.compression = compression
+        self._primary = primary
+        self.chaos = chaos
+        self._last_save_t: Optional[float] = None
+        self._queue: "queue.Queue[_SaveJob]" = queue.Queue(maxsize=2)
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        # serializes the actual fs writes: a BLOCKING save (preemption)
+        # may run on the caller thread while the async worker is mid-job
+        self._write_lock = threading.Lock()
+        # telemetry, mirroring ops/dispatch.DispatchStats' role: the bench
+        # leg and tests read these instead of re-deriving from the fs
+        self.stats = {"saves": 0, "skipped_busy": 0, "bytes": 0,
+                      "write_s": 0.0, "pruned": 0, "errors": 0}
+        self.errors: List[BaseException] = []
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ---------------------------------------------------------------- policy
+    def is_primary(self) -> bool:
+        if self._primary is None:
+            from deeplearning4j_tpu.parallel.multihost import is_primary
+
+            self._primary = is_primary()
+        return self._primary
+
+    def should_save(self, step: int) -> bool:
+        """Step/time cadence (both opt-in; the trainer additionally saves
+        on preemption and at fit() exit regardless of cadence)."""
+        if self.every_steps and step % self.every_steps == 0:
+            return True
+        if self.every_seconds is not None:
+            now = time.monotonic()
+            if (self._last_save_t is None
+                    or now - self._last_save_t >= self.every_seconds):
+                return True
+        return False
+
+    # ----------------------------------------------------------------- save
+    def save(self, net, *, step: int, epoch: int = 0,
+             iterator_state: Optional[dict] = None,
+             block: Optional[bool] = None) -> Optional[str]:
+        """Checkpoint `net` (MultiLayerNetwork or ComputationGraph) as
+        step `step`. Synchronous part: host snapshot of
+        params/states/updater + training state. Async part (unless
+        ``block`` or sync mode): zip/fsync/manifest/commit/retention in
+        the worker thread. Returns the committed path when blocking, else
+        None (the commit is observable via flush()/checkpoints()).
+
+        A non-blocking save while the previous write is still in flight
+        is SKIPPED (counted in stats["skipped_busy"]) rather than queued
+        without bound — checkpoint cadence must never grow an unbounded
+        snapshot backlog in host RAM. Blocking saves (preemption,
+        fit-exit) always wait for a slot instead."""
+        if not self.is_primary():
+            return None
+        block = (not self.async_save) if block is None else block
+        training_state = dict(net.training_state()) if hasattr(
+            net, "training_state") else {"iteration": int(net.iteration)}
+        training_state.update({
+            "step": int(step),
+            "epoch": int(epoch),
+            "iterator_state": iterator_state,
+        })
+        from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+        job = _SaveJob(
+            step=int(step),
+            model_class=type(net).__name__,
+            conf_json=net.conf.to_json(),
+            params=_host_tree(net.params),
+            states=_host_tree(net.states),
+            updater_state=_host_tree(net.updater_state),
+            meta=ModelSerializer._container_meta(net),
+            training_state=training_state,
+        )
+        self._last_save_t = time.monotonic()
+        if block:
+            self._write(job)
+            if job.error is not None:
+                # raised HERE means handled here: drop it from the list
+                # flush() reports, or the next flush would re-raise an
+                # error the caller already dealt with
+                try:
+                    self.errors.remove(job.error)
+                except ValueError:
+                    pass
+                raise job.error
+            return job.path
+        self._ensure_worker()
+        try:
+            self._queue.put_nowait(job)
+        except queue.Full:
+            self.stats["skipped_busy"] += 1
+            logger.warning(
+                "checkpoint step %d skipped: previous write still in "
+                "flight (next cadence point will retry)", step)
+            return None
+        return None
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop, daemon=True,
+                    name="ckpt-writer")
+                self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:  # close() sentinel
+                # the sentinel must be task_done'd too: a manager reused
+                # after close() (ensure_worker restarts the thread) would
+                # otherwise deadlock every later flush()'s queue.join()
+                self._queue.task_done()
+                return
+            self._write(job)
+            self._queue.task_done()
+
+    def flush(self) -> None:
+        """Wait until every enqueued save has committed; re-raise the
+        first writer error (a failed checkpoint must not stay silent)."""
+        if self._worker is not None:
+            self._queue.join()
+        if self.errors:
+            err, self.errors = self.errors[0], []
+            raise err
+
+    def close(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.join()
+            self._queue.put(None)
+            self._worker.join(timeout=10.0)
+        self._worker = None
+
+    # ---------------------------------------------------------------- write
+    def _ckpt_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"{_CKPT_PREFIX}{step:08d}")
+
+    def _write(self, job: _SaveJob) -> None:
+        with self._write_lock:
+            self._write_locked(job)
+
+    def _write_locked(self, job: _SaveJob) -> None:
+        t0 = time.perf_counter()
+        final = self._ckpt_path(job.step)
+        tmp = final + ".tmp"
+        try:
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            files = (self._write_zip_payload(tmp, job)
+                     if self.backend == "zip"
+                     else self._write_sharded_payload(tmp, job))
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "backend": self.backend,
+                "step": job.step,
+                "epoch": job.training_state.get("epoch", 0),
+                "iteration": job.training_state.get("iteration"),
+                "model_class": job.model_class,
+                "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "files": files,
+                "iterator_state": job.training_state.get("iterator_state"),
+            }
+            mpath = os.path.join(tmp, MANIFEST)
+            with open(mpath, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            # the commit: one directory rename; a crash before this line
+            # leaves only a .tmp dir that the next write sweeps away.
+            # A re-save of an existing step (save_on_exit, restart of a
+            # finished run) renames the old dir ASIDE first — an rmtree
+            # here would open a whole-tree-wide window with NO checkpoint
+            # for the step; .old dirs don't parse as checkpoints, so the
+            # scan never sees the intermediate state
+            old = None
+            if os.path.isdir(final):
+                old = final + ".old"
+                if os.path.isdir(old):
+                    shutil.rmtree(old)
+                os.replace(final, old)
+            os.replace(tmp, final)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+            fsync_dir(self.directory)
+            job.path = final
+            self.stats["saves"] += 1
+            self.stats["bytes"] += sum(f["bytes"] for f in files.values())
+            self.stats["write_s"] += time.perf_counter() - t0
+            if self.chaos is not None:
+                self.chaos.on_checkpoint_written(final, job.step)
+            self._retain()
+        except BaseException as e:  # noqa: BLE001 — surfaced via flush()
+            job.error = e
+            self.stats["errors"] += 1
+            self.errors.append(e)
+            logger.error("checkpoint step %d failed: %s", job.step, e)
+            shutil.rmtree(tmp, ignore_errors=True)
+        finally:
+            job.done.set()
+
+    def _write_zip_payload(self, tmp: str, job: _SaveJob) -> Dict[str, dict]:
+        from deeplearning4j_tpu.utils.serialization import write_model_parts
+
+        zpath = os.path.join(tmp, "model.zip")
+        write_model_parts(
+            zpath,
+            model_class=job.model_class,
+            conf_json=job.conf_json,
+            params=job.params,
+            states=job.states,
+            updater_state=job.updater_state,
+            meta=job.meta,
+            training_state=job.training_state,
+            compression=self.compression,
+        )
+        fsync_file(zpath)
+        return {"model.zip": {"sha256": file_sha256(zpath),
+                              "bytes": os.path.getsize(zpath)}}
+
+    def _write_sharded_payload(self, tmp: str, job: _SaveJob) -> Dict[str, dict]:
+        """Orbax layout for mesh-sharded state (utils/sharded_checkpoint):
+        the pytrees stream through orbax's per-shard writers; config and
+        training state ride as plain JSON files; the manifest digests the
+        whole tree so verification covers every shard file."""
+        from deeplearning4j_tpu.utils import sharded_checkpoint as sc
+
+        sc.save_pytree(os.path.join(tmp, "state"), {
+            "params": job.params,
+            "states": job.states,
+            "updater": job.updater_state,
+        })
+        from deeplearning4j_tpu.utils.serialization import (
+            _jsonable_training_state,
+        )
+
+        with open(os.path.join(tmp, "configuration.json"), "w") as f:
+            f.write(job.conf_json)
+        with open(os.path.join(tmp, "training_state.json"), "w") as f:
+            json.dump(_jsonable_training_state(job.training_state), f)
+        with open(os.path.join(tmp, "metadata.json"), "w") as f:
+            json.dump({"model_class": job.model_class,
+                       "format": "orbax-dir", **job.meta}, f)
+        files = {}
+        for root, _, names in os.walk(tmp):
+            for name in names:
+                p = os.path.join(root, name)
+                fsync_file(p)
+                rel = os.path.relpath(p, tmp)
+                files[rel] = {"sha256": file_sha256(p),
+                              "bytes": os.path.getsize(p)}
+        return files
+
+    # ------------------------------------------------------------- retention
+    def _retain(self) -> None:
+        """keep-last-k + keep-every-n anchors; prune the rest. Runs after
+        every successful commit (never deletes the checkpoint it just
+        wrote: it is always within the last k >= 1)."""
+        entries = self.checkpoints()
+        if not entries:
+            return
+        keep = {s for s, _ in entries[-max(1, self.keep_last):]}
+        if self.keep_every:
+            keep |= {s for s, _ in entries if s % self.keep_every == 0}
+        for step, path in entries:
+            if step not in keep:
+                shutil.rmtree(path, ignore_errors=True)
+                self.stats["pruned"] += 1
+
+    # ----------------------------------------------------------------- scan
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """Committed checkpoints, sorted ascending by step."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            if not name.startswith(_CKPT_PREFIX) or name.endswith(".tmp"):
+                continue
+            try:
+                step = int(name[len(_CKPT_PREFIX):])
+            except ValueError:
+                continue
+            out.append((step, os.path.join(self.directory, name)))
+        return sorted(out)
+
+    def verify(self, path: str) -> Tuple[bool, str]:
+        """Re-hash every manifested file. (ok, reason)."""
+        mpath = os.path.join(path, MANIFEST)
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"manifest unreadable: {e}"
+        for rel, info in manifest.get("files", {}).items():
+            p = os.path.join(path, rel)
+            if not os.path.isfile(p):
+                return False, f"missing payload file {rel}"
+            if os.path.getsize(p) != info["bytes"]:
+                return False, (f"{rel}: size {os.path.getsize(p)} != "
+                               f"manifested {info['bytes']}")
+            if file_sha256(p) != info["sha256"]:
+                return False, f"{rel}: sha256 mismatch"
+        return True, "ok"
+
+    def read_manifest(self, path: str) -> Dict[str, Any]:
+        with open(os.path.join(path, MANIFEST)) as f:
+            return json.load(f)
+
+    def latest_intact(self) -> Optional[Tuple[str, Dict[str, Any]]]:
+        """Newest checkpoint that passes verification, scanning backwards
+        with a LOUD warning per corrupt candidate — fallback may cost
+        history, silence may cost correctness."""
+        for step, path in reversed(self.checkpoints()):
+            ok, reason = self.verify(path)
+            if ok:
+                return path, self.read_manifest(path)
+            logger.warning(
+                "checkpoint %s is corrupt (%s); falling back to the "
+                "previous retained checkpoint", path, reason)
+        return None
+
+    # -------------------------------------------------------------- restore
+    def restore(self, path: str, net) -> Dict[str, Any]:
+        """Restore checkpoint dir `path` into the existing `net` (must be
+        built from the same configuration). Verifies first — an explicit
+        restore of a corrupt checkpoint raises :class:`CheckpointCorrupt`
+        rather than loading garbage."""
+        ok, reason = self.verify(path)
+        if not ok:
+            raise CheckpointCorrupt(f"{path}: {reason}")
+        manifest = self.read_manifest(path)
+        if manifest.get("backend", "zip") == "zip":
+            from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+            ts = ModelSerializer.load_into(
+                net, os.path.join(path, "model.zip"))
+        else:
+            ts = self._restore_sharded(path, net)
+        return {
+            "step": int(manifest.get("step", ts.get("step", 0) or 0)),
+            "epoch": int(ts.get("epoch", manifest.get("epoch", 0)) or 0),
+            "iterator_state": ts.get("iterator_state",
+                                     manifest.get("iterator_state")),
+            "path": path,
+        }
+
+    def _restore_sharded(self, path: str, net) -> Dict[str, Any]:
+        from deeplearning4j_tpu.utils import sharded_checkpoint as sc
+
+        if net.params is None:
+            net.init()
+        state = sc.restore_pytree(os.path.join(path, "state"), {
+            "params": net.params,
+            "states": net.states,
+            "updater": net.updater_state,
+        })
+        net.params = state["params"]
+        net.states = state["states"]
+        net.updater_state = state["updater"]
+        with open(os.path.join(path, "training_state.json")) as f:
+            ts = json.load(f)
+        if hasattr(net, "restore_training_state"):
+            net.restore_training_state(ts)
+        return ts
+
+    def restore_latest(self, net) -> Optional[Dict[str, Any]]:
+        """Restore the newest intact checkpoint into `net`; None when the
+        directory holds nothing restorable (fresh run)."""
+        found = self.latest_intact()
+        if found is None:
+            return None
+        path, _ = found
+        return self.restore(path, net)

@@ -9,6 +9,13 @@ restore): a ZIP holding
   state.npz            — layer states (BN running stats, RNN carry)
   updater.npz          — optimizer state       (reference: updater.bin)
   metadata.json        — iteration counter, format version
+  training_state.json  — OPTIONAL exact-resume section (updater step, RNG
+                         key, epoch / data-iterator cursor) — the three-part
+                         reference layout silently drops these, which is why
+                         a reference restore was never bit-exact; written
+                         only when the caller supplies it (resilience/
+                         CheckpointManager does), and old zips without the
+                         entry keep loading unchanged.
 
 Parameters are stored leaf-by-leaf keyed by their pytree path (the pytree
 replaces the reference's single flat param vector; keys make the format
@@ -26,6 +33,61 @@ import jax
 import numpy as np
 
 FORMAT_VERSION = 1
+
+TRAINING_STATE_ENTRY = "training_state.json"
+
+
+def _jsonable_training_state(ts: Dict[str, Any]) -> Dict[str, Any]:
+    """Training state with array-valued fields (the RNG key) converted to
+    plain lists so the section stays a human-inspectable JSON entry."""
+    out = dict(ts)
+    if out.get("rng") is not None:
+        out["rng"] = np.asarray(out["rng"]).astype(np.uint32).tolist()
+    return out
+
+
+def write_model_parts(
+    path: str,
+    *,
+    model_class: str,
+    conf_json: str,
+    params,
+    states=None,
+    updater_state=None,
+    meta: dict = None,
+    training_state: dict = None,
+    compression: int = zipfile.ZIP_DEFLATED,
+) -> None:
+    """The single zip writer every checkpoint path shares. ``write_model``
+    reads the parts off a live network; the resilience CheckpointManager
+    passes host-side SNAPSHOTS instead (its async worker must never read a
+    net whose buffers the next donated train step has already consumed) —
+    one writer, so the format cannot fork between the sync and async
+    paths. ``compression`` lets the manager choose ZIP_STORED: checkpoint
+    cadence is dominated by serialize+write stall, and deflate burns the
+    1-core host's only core."""
+    meta = {"format_version": FORMAT_VERSION, "model_class": model_class,
+            **(meta or {})}
+    with zipfile.ZipFile(path, "w", compression) as z:
+        z.writestr("configuration.json", conf_json)
+        z.writestr("coefficients.npz", _tree_to_npz_bytes(params))
+        if states is not None:
+            z.writestr("state.npz", _tree_to_npz_bytes(states))
+        if updater_state is not None:
+            z.writestr("updater.npz", _tree_to_npz_bytes(updater_state))
+        if training_state is not None:
+            z.writestr(TRAINING_STATE_ENTRY,
+                       json.dumps(_jsonable_training_state(training_state)))
+        z.writestr("metadata.json", json.dumps(meta))
+
+
+def read_training_state(path: str) -> Dict[str, Any] | None:
+    """The optional exact-resume section of a checkpoint zip, or None for
+    a pre-resilience three-part zip (old checkpoints stay loadable)."""
+    with zipfile.ZipFile(path, "r") as z:
+        if TRAINING_STATE_ENTRY not in z.namelist():
+            return None
+        return json.loads(z.read(TRAINING_STATE_ENTRY).decode())
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -96,7 +158,7 @@ def read_flagship_zip(path: str, expected_class: str):
 
 class ModelSerializer:
     @staticmethod
-    def write_model(net, path: str, save_updater: bool = True) -> None:
+    def _container_meta(net) -> Dict[str, Any]:
         is_graph = hasattr(net, "_input_shapes")  # ComputationGraph
         if is_graph:
             ishape = (
@@ -106,19 +168,68 @@ class ModelSerializer:
             )
         else:
             ishape = list(net._input_shape) if net._input_shape else None
-        meta: Dict[str, Any] = {
-            "format_version": FORMAT_VERSION,
+        return {
             "iteration": net.iteration,
             "input_shape": ishape,
-            "model_class": type(net).__name__,
         }
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("configuration.json", net.conf.to_json())
-            z.writestr("coefficients.npz", _tree_to_npz_bytes(net.params))
-            z.writestr("state.npz", _tree_to_npz_bytes(net.states))
-            if save_updater and net.updater_state is not None:
-                z.writestr("updater.npz", _tree_to_npz_bytes(net.updater_state))
-            z.writestr("metadata.json", json.dumps(meta))
+
+    @staticmethod
+    def write_model(net, path: str, save_updater: bool = True,
+                    training_state: dict = None) -> None:
+        """`training_state` (optional): the exact-resume section — pass
+        ``net.training_state()`` (possibly extended with epoch/iterator
+        cursor) to make the zip resumable without drift; omitted, the zip
+        is the original reference-shaped three-part checkpoint."""
+        write_model_parts(
+            path,
+            model_class=type(net).__name__,
+            conf_json=net.conf.to_json(),
+            params=net.params,
+            states=net.states,
+            updater_state=(net.updater_state if save_updater else None),
+            meta=ModelSerializer._container_meta(net),
+            training_state=training_state,
+        )
+
+    @staticmethod
+    def load_into(net, path: str, load_updater: bool = True) -> Dict[str, Any]:
+        """Restore a checkpoint INTO an existing container (MLN or
+        ComputationGraph) built from the same configuration — the resume
+        path of resilience/trainer.py, which constructs the net itself and
+        must not be handed a second instance. Initializes the net from the
+        checkpoint's recorded input shape when needed, loads
+        params/states/updater by pytree-path template (a layout mismatch
+        fails loudly on the missing key), sets the iteration counter, and
+        applies the optional training-state section (RNG key) via
+        ``net.restore_training_state``. Returns the training-state dict
+        ({} for a pre-resilience zip)."""
+        with zipfile.ZipFile(path, "r") as z:
+            meta = json.loads(z.read("metadata.json").decode())
+            got = meta.get("model_class", type(net).__name__)
+            if got != type(net).__name__:
+                raise ValueError(
+                    f"checkpoint holds {got!r}, not {type(net).__name__}")
+            if net.params is None:
+                ishape = meta.get("input_shape")
+                if isinstance(ishape, dict):
+                    net.init({k: tuple(v) for k, v in ishape.items()})
+                else:
+                    net.init(tuple(ishape) if ishape else None)
+            net.params = _npz_bytes_into_tree(
+                z.read("coefficients.npz"), net.params)
+            if "state.npz" in z.namelist():
+                net.states = _npz_bytes_into_tree(
+                    z.read("state.npz"), net.states)
+            if load_updater and "updater.npz" in z.namelist():
+                net.updater_state = _npz_bytes_into_tree(
+                    z.read("updater.npz"), net.updater_state)
+            net.iteration = int(meta.get("iteration", 0))
+            ts: Dict[str, Any] = {}
+            if TRAINING_STATE_ENTRY in z.namelist():
+                ts = json.loads(z.read(TRAINING_STATE_ENTRY).decode())
+                if hasattr(net, "restore_training_state"):
+                    net.restore_training_state(ts)
+        return ts
 
     @staticmethod
     def restore_multi_layer_network(path: str, load_updater: bool = True):
@@ -141,6 +252,9 @@ class ModelSerializer:
                     z.read("updater.npz"), net.updater_state
                 )
             net.iteration = int(meta.get("iteration", 0))
+            if TRAINING_STATE_ENTRY in z.namelist():
+                net.restore_training_state(
+                    json.loads(z.read(TRAINING_STATE_ENTRY).decode()))
         return net
 
     @staticmethod
@@ -167,6 +281,9 @@ class ModelSerializer:
                     z.read("updater.npz"), net.updater_state
                 )
             net.iteration = int(meta.get("iteration", 0))
+            if TRAINING_STATE_ENTRY in z.namelist():
+                net.restore_training_state(
+                    json.loads(z.read(TRAINING_STATE_ENTRY).decode()))
         return net
 
     @staticmethod

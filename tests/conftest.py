@@ -61,6 +61,10 @@ _QUICK_FILES = {
     "test_gradient_check.py",
     "test_multilayer.py",
     "test_dispatch.py",
+    # the whole resilience suite (incl. the subprocess SIGTERM preemption
+    # leg, ~6s) fits the quick budget — crash-recovery is exactly the kind
+    # of contract a mid-round change can silently break
+    "test_resilience.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and

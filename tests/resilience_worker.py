@@ -1,0 +1,106 @@
+"""Subprocess driver for the preemption tests (tests/test_resilience.py).
+
+Runs a deterministic MLP fit under ResilientTrainer exactly as a user
+process would, in three modes:
+
+  baseline  — plain uninterrupted fit (no manager, no chaos)
+  train     — managed fit; with RES_KILL_STEP set, chaos delivers a REAL
+              SIGTERM to this process after that step -> the trainer's
+              checkpoint-before-death path commits a goodbye checkpoint
+              and the process exits 143 (after dumping its loss curve so
+              the parent can stitch). Re-exec'd with the same checkpoint
+              dir and no kill, it resumes and finishes.
+
+Every mode dumps final params + losses + the resume step to an npz the
+parent compares bit-for-bit.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.nn.conf import (  # noqa: E402
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.resilience import (  # noqa: E402
+    ChaosConfig,
+    ChaosMonkey,
+    CheckpointManager,
+    Preempted,
+    ResilientTrainer,
+)
+
+EPOCHS = 2
+
+
+def build() -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+        .updater("adam").list()
+        .layer(0, DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf)
+
+
+def make_iterator() -> ListDataSetIterator:
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+    return ListDataSetIterator(x, y, batch=8)
+
+
+def dump(path: str, trainer: ResilientTrainer) -> None:
+    leaves = jax.tree_util.tree_leaves(trainer.net.params)
+    np.savez(
+        path,
+        losses=np.asarray(trainer.losses, np.float64),
+        resumed=np.asarray(
+            -1 if trainer.resumed_step is None else trainer.resumed_step),
+        step=np.asarray(trainer.step),
+        **{f"p{i}": np.asarray(a) for i, a in enumerate(leaves)},
+    )
+
+
+def main() -> None:
+    mode, ckpt_dir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+    it = make_iterator()
+    if mode == "baseline":
+        trainer = ResilientTrainer(build())
+        trainer.fit(it, num_epochs=EPOCHS)
+    elif mode == "train":
+        manager = CheckpointManager(ckpt_dir, every_steps=3, keep_last=3)
+        kill = int(os.environ.get("RES_KILL_STEP", "0"))
+        chaos = (ChaosMonkey(ChaosConfig(kill_at_step=kill,
+                                         kill_mode="sigterm"))
+                 if kill else None)
+        trainer = ResilientTrainer(build(), manager, chaos=chaos)
+        try:
+            trainer.fit(it, num_epochs=EPOCHS)
+        except Preempted as e:
+            dump(out, trainer)
+            print(f"PREEMPTED step={e.step} ckpt={e.path}")
+            sys.exit(143)
+        finally:
+            manager.close()
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    dump(out, trainer)
+    print(f"DONE step={trainer.step} resumed={trainer.resumed_step}")
+
+
+if __name__ == "__main__":
+    main()

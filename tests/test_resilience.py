@@ -1,0 +1,637 @@
+"""Fault-tolerant training runtime (deeplearning4j_tpu/resilience/).
+
+The headline contract is the resilience analogue of the repo's
+distributed==serial convention: training KILLED at step k (via the
+deterministic chaos harness) and RESUMED from the async checkpoint
+produces bit-identical final params and loss curve to the uninterrupted
+run — for MultiLayerNetwork, ComputationGraph, and the DP
+ParameterAveragingTrainer, including RNG and data-iterator cursor state.
+Plus: corruption detection with fallback (truncation/bit-flip), retention
+policy, SIGTERM preemption -> checkpoint-before-death -> re-exec resume
+(real subprocesses), transient-error retry, and the zero-behavior-change
+contract for a disabled harness.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import zipfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterator import (
+    AsyncDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience import (
+    ChaosConfig,
+    ChaosMonkey,
+    CheckpointCorrupt,
+    CheckpointManager,
+    InjectedKill,
+    ResilientTrainer,
+    TransientDeviceError,
+)
+from deeplearning4j_tpu.resilience import chaos as chaos_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# deterministic shared data (f32: the equivalence bar is bit-identity)
+_RNG = np.random.default_rng(0)
+X = _RNG.standard_normal((48, 6)).astype(np.float32)
+Y = np.eye(3, dtype=np.float32)[_RNG.integers(0, 3, 48)]
+
+
+def build_mln() -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+        .updater("adam").list()
+        .layer(0, DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf)
+
+
+def build_cg() -> ComputationGraph:
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).learning_rate(0.05)
+        .updater("adam").graph_builder().add_inputs("in")
+        .add_layer("d", DenseLayer(n_in=6, n_out=8, activation="tanh"), "in")
+        .add_layer("out", OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                      loss_function="mcxent"), "d")
+        .set_outputs("out").build()
+    )
+    return ComputationGraph(conf)
+
+
+def mk_iterator(batch: int = 8) -> ListDataSetIterator:
+    return ListDataSetIterator(X, Y, batch=batch)
+
+
+def params_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _resume_equivalence(builder, kill_at: int, tmp: str,
+                        epochs: int = 3) -> None:
+    """Kill at step k, restore from the async checkpoint, finish: final
+    params AND loss curve bit-identical to the uninterrupted run."""
+    baseline = ResilientTrainer(builder())
+    baseline.fit(mk_iterator(), num_epochs=epochs)
+
+    mgr = CheckpointManager(tmp, every_steps=4, keep_last=3)
+    killed = ResilientTrainer(
+        builder(), mgr, chaos=ChaosMonkey(ChaosConfig(kill_at_step=kill_at)))
+    with pytest.raises(InjectedKill):
+        killed.fit(mk_iterator(), num_epochs=epochs)
+    mgr.close()
+
+    mgr2 = CheckpointManager(tmp, every_steps=4, keep_last=3)
+    resumed = ResilientTrainer(builder(), mgr2)
+    resumed.fit(mk_iterator(), num_epochs=epochs)
+    mgr2.close()
+
+    assert resumed.resumed_step is not None
+    assert 0 < resumed.resumed_step <= kill_at
+    assert resumed.step == baseline.step
+    stitched = killed.losses[:resumed.resumed_step] + resumed.losses
+    assert stitched == baseline.losses, "loss curve diverged after resume"
+    assert params_equal(baseline.net.params, resumed.net.params)
+    assert params_equal(baseline.net.updater_state,
+                        resumed.net.updater_state)
+
+
+def test_resume_equivalence_mln(tmp_path):
+    _resume_equivalence(build_mln, kill_at=10, tmp=str(tmp_path))
+
+
+def test_resume_equivalence_cg(tmp_path):
+    _resume_equivalence(build_cg, kill_at=10, tmp=str(tmp_path))
+
+
+def test_resume_equivalence_param_averaging(tmp_path):
+    """The DP trainer (ParameterAveragingTrainer, shard_map workers on the
+    virtual mesh): killed mid-run, restored, == uninterrupted bit-exact.
+    One iterator batch = one averaging round."""
+    from deeplearning4j_tpu.parallel.data_parallel import (
+        ParameterAveragingTrainer,
+    )
+
+    n_workers, freq = 4, 1
+    it = lambda: ListDataSetIterator(X, Y, batch=16)  # 16 = freq*4 workers*4
+
+    def run(manager=None, chaos=None):
+        trainer = ResilientTrainer(
+            ParameterAveragingTrainer(build_mln(), num_workers=n_workers,
+                                      averaging_frequency=freq),
+            manager, chaos=chaos)
+        return trainer
+
+    baseline = run()
+    baseline.fit(it(), num_epochs=2)
+
+    mgr = CheckpointManager(str(tmp_path), every_steps=2, keep_last=2)
+    killed = run(mgr, ChaosMonkey(ChaosConfig(kill_at_step=4)))
+    with pytest.raises(InjectedKill):
+        killed.fit(it(), num_epochs=2)
+    mgr.close()
+
+    mgr2 = CheckpointManager(str(tmp_path), every_steps=2, keep_last=2)
+    resumed = run(mgr2)
+    resumed.fit(it(), num_epochs=2)
+    mgr2.close()
+
+    assert resumed.resumed_step == 4
+    stitched = killed.losses[:4] + resumed.losses
+    assert stitched == baseline.losses
+    assert params_equal(baseline.net.params, resumed.net.params)
+    assert baseline.net.iteration == resumed.net.iteration
+
+
+# ---------------------------------------------------------------- manager
+def test_async_checkpoint_matches_sync(tmp_path):
+    """The async writer must commit the state AS OF the save call, not as
+    of write time: train 3 steps, save async, train 3 more, flush — the
+    checkpoint equals a sync save taken at the same step."""
+    net = build_mln().init()
+    for i in range(3):
+        net.fit(X[:8], Y[:8])
+    sync_mgr = CheckpointManager(str(tmp_path / "sync"), async_save=False)
+    sync_mgr.save(net, step=3)
+    async_mgr = CheckpointManager(str(tmp_path / "async"), async_save=True)
+    async_mgr.save(net, step=3)
+    for i in range(3):  # keep training while the async write is in flight
+        net.fit(X[:8], Y[:8])
+    async_mgr.flush()
+    async_mgr.close()
+
+    a, b = build_mln(), build_mln()
+    s1 = sync_mgr.restore_latest(a)
+    s2 = async_mgr.restore_latest(b)
+    assert s1["step"] == s2["step"] == 3
+    assert params_equal(a.params, b.params)
+    assert params_equal(a.updater_state, b.updater_state)
+    assert a.iteration == b.iteration == 3
+
+
+def test_retention_keep_last_and_every(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), every_steps=1, keep_last=2,
+                            keep_every=4, async_save=False)
+    net = build_mln().init()
+    for step in range(1, 10):
+        mgr.save(net, step=step)
+    steps = [s for s, _ in mgr.checkpoints()]
+    assert steps == [4, 8, 9]  # keep_every anchors {4,8} + last 2 {8,9}
+    assert mgr.stats["pruned"] > 0
+
+
+def test_corrupt_bitflip_falls_back(tmp_path, caplog):
+    mgr = CheckpointManager(str(tmp_path), async_save=False, keep_last=5)
+    net = build_mln().init()
+    net.fit(X[:8], Y[:8])
+    mgr.save(net, step=1)
+    net.fit(X[:8], Y[:8])
+    mgr.save(net, step=2)
+    (_, newest), = [c for c in mgr.checkpoints() if c[0] == 2]
+    chaos_mod.bitflip_file(os.path.join(newest, "model.zip"))
+    fresh = build_mln()
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        restored = mgr.restore_latest(fresh)
+    assert restored is not None and restored["step"] == 1
+    assert any("corrupt" in r.message for r in caplog.records)
+    assert fresh.iteration == 1
+
+
+def test_corrupt_truncate_all_is_loud(tmp_path):
+    """Every retained checkpoint truncated: restore_latest returns None
+    (fresh start) and an explicit restore raises — never silent garbage."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    net = build_mln().init()
+    mgr.save(net, step=1)
+    (_, path), = mgr.checkpoints()
+    chaos_mod.truncate_file(os.path.join(path, "model.zip"), keep=10)
+    assert mgr.restore_latest(build_mln()) is None
+    with pytest.raises(CheckpointCorrupt):
+        mgr.restore(path, build_mln())
+
+
+def test_chaos_driven_corruption_via_manager(tmp_path):
+    """The write-then-truncate fault wired through the manager's chaos
+    hook (config-driven, as the tests are meant to use it)."""
+    chaos = ChaosMonkey(ChaosConfig(
+        corrupt_checkpoint={"at_step": 2, "mode": "truncate"}))
+    mgr = CheckpointManager(str(tmp_path), async_save=False, keep_last=5,
+                            chaos=chaos)
+    net = build_mln().init()
+    net.fit(X[:8], Y[:8])
+    mgr.save(net, step=1)
+    net.fit(X[:8], Y[:8])
+    mgr.save(net, step=2)
+    assert (2, "corrupt:truncate") in chaos.log
+    found = mgr.latest_intact()
+    assert found is not None
+    assert found[1]["step"] == 1  # fell back past the truncated step-2
+
+
+def test_skip_when_writer_busy(tmp_path, monkeypatch):
+    """Non-blocking saves never queue without bound: while a write is in
+    flight, further cadence saves are skipped and counted."""
+    mgr = CheckpointManager(str(tmp_path), async_save=True, keep_last=9)
+    slow = {"done": False}
+    orig = mgr._write_zip_payload
+
+    def slow_payload(tmp, job):
+        time.sleep(0.4)
+        return orig(tmp, job)
+
+    monkeypatch.setattr(mgr, "_write_zip_payload", slow_payload)
+    net = build_mln().init()
+    for step in range(1, 8):
+        mgr.save(net, step=step)
+    mgr.flush()
+    mgr.close()
+    assert mgr.stats["skipped_busy"] > 0
+    assert mgr.stats["saves"] >= 1
+    assert mgr.stats["saves"] + mgr.stats["skipped_busy"] == 7
+
+
+def test_manager_reuse_after_close_does_not_deadlock(tmp_path):
+    """Regression: the close() sentinel must be task_done'd — a manager
+    reused after close() (worker restarts on the next async save) would
+    otherwise hang every later flush() in queue.join()."""
+    mgr = CheckpointManager(str(tmp_path), async_save=True)
+    net = build_mln().init()
+    mgr.save(net, step=1)
+    mgr.flush()
+    mgr.close()
+    mgr.save(net, step=2)
+    done = threading.Event()
+
+    def flusher():
+        mgr.flush()
+        done.set()
+
+    t = threading.Thread(target=flusher, daemon=True)
+    t.start()
+    assert done.wait(timeout=30.0), "flush() deadlocked after close+reuse"
+    mgr.close()
+    assert [s for s, _ in mgr.checkpoints()] == [1, 2]
+
+
+def test_blocking_save_error_not_rereported_by_flush(tmp_path, monkeypatch):
+    """Regression: an error RAISED by a blocking save is handled by the
+    caller; flush() must not re-raise it later."""
+    mgr = CheckpointManager(str(tmp_path), async_save=False)
+    net = build_mln().init()
+
+    def boom(tmp, job):
+        raise OSError("disk full (injected)")
+
+    monkeypatch.setattr(mgr, "_write_zip_payload", boom)
+    with pytest.raises(OSError, match="disk full"):
+        mgr.save(net, step=1)
+    monkeypatch.undo()
+    mgr.save(net, step=2)
+    mgr.flush()  # must NOT re-raise the step-1 error
+    assert [s for s, _ in mgr.checkpoints()] == [2]
+
+
+def test_non_primary_process_never_writes(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), primary=False, async_save=False)
+    mgr.save(build_mln().init(), step=1)
+    assert mgr.checkpoints() == []
+
+
+def test_sharded_backend_roundtrip(tmp_path):
+    """The orbax layering: same manifest/verify/restore plane over the
+    sharded layout (utils/sharded_checkpoint.py)."""
+    pytest.importorskip("orbax.checkpoint")
+    net = build_mln().init()
+    net.fit(X[:8], Y[:8])
+    mgr = CheckpointManager(str(tmp_path), backend="sharded",
+                            async_save=False)
+    mgr.save(net, step=1)
+    path, manifest = mgr.latest_intact()
+    assert manifest["backend"] == "sharded"
+    fresh = build_mln().init()
+    restored = mgr.restore(path, fresh)
+    assert restored["step"] == 1
+    assert params_equal(net.params, fresh.params)
+    assert fresh.iteration == net.iteration
+
+
+# ----------------------------------------------------------------- chaos
+def test_transient_error_retry_with_backoff(tmp_path):
+    """A transient device error at step k, retried with backoff, leaves
+    the run bit-identical to the uninterrupted one (the step eventually
+    ran exactly once)."""
+    baseline = ResilientTrainer(build_mln())
+    baseline.fit(mk_iterator(), num_epochs=1)
+
+    chaos = ChaosMonkey(ChaosConfig(transient_error_at_step=3,
+                                    transient_error_count=2))
+    retried = ResilientTrainer(build_mln(), chaos=chaos,
+                               max_step_retries=2, retry_backoff_s=0.01)
+    retried.fit(mk_iterator(), num_epochs=1)
+    assert [s for s, f in chaos.log if f == "transient_error"] == [3, 3]
+    assert retried.losses == baseline.losses
+    assert params_equal(baseline.net.params, retried.net.params)
+
+
+def test_transient_error_exhausts_retries():
+    chaos = ChaosMonkey(ChaosConfig(transient_error_at_step=2,
+                                    transient_error_count=5))
+    trainer = ResilientTrainer(build_mln(), chaos=chaos,
+                               max_step_retries=1, retry_backoff_s=0.0)
+    with pytest.raises(TransientDeviceError):
+        trainer.fit(mk_iterator(), num_epochs=1)
+
+
+def test_stalled_feed_only_delays():
+    chaos = ChaosMonkey(ChaosConfig(stall_at_step=2, stall_seconds=0.2))
+    baseline = ResilientTrainer(build_mln())
+    baseline.fit(mk_iterator(), num_epochs=1)
+    stalled = ResilientTrainer(build_mln(), chaos=chaos)
+    t0 = time.perf_counter()
+    stalled.fit(mk_iterator(), num_epochs=1)
+    assert time.perf_counter() - t0 >= 0.2
+    assert stalled.losses == baseline.losses
+    assert params_equal(baseline.net.params, stalled.net.params)
+
+
+def test_disabled_harness_is_zero_change():
+    """Chaos faults are opt-in: a ResilientTrainer with no manager and no
+    chaos is bit-identical to the plain fit loop."""
+    plain = build_mln()
+    for epoch in range(2):
+        for ds in mk_iterator():
+            plain.fit(ds.features, ds.labels)
+    wrapped = ResilientTrainer(build_mln())
+    wrapped.fit(mk_iterator(), num_epochs=2)
+    assert params_equal(plain.params, wrapped.net.params)
+    assert plain.iteration == wrapped.net.iteration
+
+
+# -------------------------------------------------------------- iterators
+def test_list_iterator_state_roundtrip():
+    it = mk_iterator(batch=8)
+    seen = []
+    for i, ds in enumerate(it):
+        seen.append(ds)
+        if i == 2:
+            st = it.state()
+            break
+    assert st == {"cursor": 3}
+    it2 = mk_iterator(batch=8)
+    it2.restore_state(st)
+    rest = list(it2)
+    assert len(seen) + len(rest) == 6
+    full = list(mk_iterator(batch=8))
+    for got, want in zip(seen + rest, full):
+        assert np.array_equal(got.features, want.features)
+    # normal passes are unaffected after the one-shot resume
+    assert len(list(it2)) == 6
+
+
+def test_sampling_iterator_state_roundtrip():
+    mk = lambda: SamplingDataSetIterator(X, Y, batch=4, total_batches=6,
+                                         seed=3)
+    full = [ds.features for ds in mk()]
+    it = mk()
+    out = []
+    for i, ds in enumerate(it):
+        out.append(ds.features)
+        if i == 1:
+            st = it.state()
+            break
+    it2 = SamplingDataSetIterator(X, Y, batch=4, total_batches=6, seed=999)
+    it2.restore_state(st)  # rng_state overrides the wrong seed
+    out += [ds.features for ds in it2]
+    assert len(out) == 6
+    for got, want in zip(out, full):
+        assert np.array_equal(got, want)
+
+
+def test_multiple_epochs_iterator_state_roundtrip():
+    mk = lambda: MultipleEpochsIterator(3, mk_iterator(batch=16))
+    full = [ds.features for ds in mk()]
+    it = mk()
+    out = []
+    for i, ds in enumerate(it):
+        out.append(ds.features)
+        if i == 4:  # mid-second-epoch (3 batches/epoch)
+            st = it.state()
+            break
+    assert st["epoch"] == 1
+    it2 = mk()
+    it2.restore_state(st)
+    out += [ds.features for ds in it2]
+    assert len(out) == len(full) == 9
+    for got, want in zip(out, full):
+        assert np.array_equal(got, want)
+
+
+def test_async_iterator_state_is_delivered_not_prefetched():
+    """The async wrapper's cursor counts batches DELIVERED to the
+    consumer, not batches its producer prefetched — resuming from its
+    state() replays exactly the undelivered remainder."""
+    base = mk_iterator(batch=8)
+    it = AsyncDataSetIterator(base, queue_size=4, device_put=False)
+    got = []
+    for i, ds in enumerate(it):
+        if i == 1:
+            time.sleep(0.1)  # let the producer run ahead
+            st = it.state()
+        got.append(ds.features)
+        if i == 2:
+            break
+    assert st == {"cursor": 2}
+    res = AsyncDataSetIterator(mk_iterator(batch=8), device_put=False)
+    res.restore_state(st)
+    rest = [np.asarray(ds.features) for ds in res]
+    full = [ds.features for ds in mk_iterator(batch=8)]
+    assert len(rest) == 4
+    for got_f, want in zip(rest, full[2:]):
+        assert np.array_equal(got_f, want)
+
+
+def test_trainer_resume_through_async_iterator(tmp_path):
+    """End-to-end: the prefetching iterator wrapped around the resumable
+    base still yields an exact resume."""
+    mk = lambda: AsyncDataSetIterator(mk_iterator(batch=8), queue_size=2,
+                                      device_put=False)
+    baseline = ResilientTrainer(build_mln())
+    baseline.fit(mk(), num_epochs=2)
+    mgr = CheckpointManager(str(tmp_path), every_steps=3, keep_last=3)
+    killed = ResilientTrainer(build_mln(), mgr,
+                              chaos=ChaosMonkey(ChaosConfig(kill_at_step=7)))
+    with pytest.raises(InjectedKill):
+        killed.fit(mk(), num_epochs=2)
+    mgr.close()
+    mgr2 = CheckpointManager(str(tmp_path), every_steps=3, keep_last=3)
+    resumed = ResilientTrainer(build_mln(), mgr2)
+    resumed.fit(mk(), num_epochs=2)
+    mgr2.close()
+    stitched = killed.losses[:resumed.resumed_step] + resumed.losses
+    assert stitched == baseline.losses
+    assert params_equal(baseline.net.params, resumed.net.params)
+
+
+# ----------------------------------------------------------- serialization
+def test_zip_training_state_section_roundtrip(tmp_path):
+    """Satellite: the optional training-state section in the checkpoint
+    zip (updater step, RNG key, epoch/cursor) — and old 3-part zips stay
+    loadable."""
+    from deeplearning4j_tpu.utils.serialization import (
+        ModelSerializer,
+        read_training_state,
+    )
+
+    net = build_mln().init()
+    net.fit(X[:8], Y[:8])
+    net.fit(X[:8], Y[:8])
+    p_new = str(tmp_path / "with_ts.zip")
+    ts = dict(net.training_state(), epoch=1,
+              iterator_state={"cursor": 2})
+    ModelSerializer.write_model(net, p_new, training_state=ts)
+    got = read_training_state(p_new)
+    assert got["iteration"] == 2
+    assert got["epoch"] == 1
+    assert got["iterator_state"] == {"cursor": 2}
+    assert got["rng"] == np.asarray(net._rng, np.uint32).tolist()
+    fresh = build_mln()
+    loaded_ts = ModelSerializer.load_into(fresh, p_new)
+    assert loaded_ts["iterator_state"] == {"cursor": 2}
+    assert fresh.iteration == 2
+    assert np.array_equal(np.asarray(fresh._rng), np.asarray(net._rng))
+    assert params_equal(fresh.params, net.params)
+
+    # old-format zip (no training_state entry) still loads
+    p_old = str(tmp_path / "old.zip")
+    ModelSerializer.write_model(net, p_old)
+    with zipfile.ZipFile(p_old) as z:
+        assert "training_state.json" not in z.namelist()
+    assert read_training_state(p_old) is None
+    restored = ModelSerializer.restore_multi_layer_network(p_old)
+    assert params_equal(restored.params, net.params)
+
+
+def test_load_into_rejects_wrong_class(tmp_path):
+    from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+    net = build_mln().init()
+    p = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, p)
+    with pytest.raises(ValueError, match="not ComputationGraph"):
+        ModelSerializer.load_into(build_cg(), p)
+
+
+def test_early_stopping_savers_atomic_and_managed(tmp_path):
+    """Satellite: savers route through the resilience plane — atomic
+    best/latest files, and the managed saver's digested latest chain."""
+    from deeplearning4j_tpu.earlystopping.savers import (
+        CheckpointManagerSaver,
+        LocalFileModelSaver,
+    )
+
+    net = build_mln().init()
+    net.fit(X[:8], Y[:8])
+    saver = LocalFileModelSaver(str(tmp_path / "lfs"))
+    saver.save_best_model(net, 0.5)
+    best = saver.get_best_model()
+    assert params_equal(best.params, net.params)
+    assert not [f for f in os.listdir(str(tmp_path / "lfs"))
+                if ".tmp" in f], "tmp files must not survive a save"
+
+    managed = CheckpointManagerSaver(str(tmp_path / "managed"))
+    managed.save_latest_model(net, 0.5)
+    net.fit(X[:8], Y[:8])
+    managed.save_latest_model(net, 0.4)
+    managed.save_best_model(net, 0.4)
+    latest = managed.get_latest_model()
+    assert params_equal(latest.params, net.params)
+    assert latest.iteration == net.iteration
+    managed.manager.close()
+
+    # restart continuity: a NEW saver over the same directory continues
+    # the step chain — its first save must become the latest, not fall
+    # below the retention keep-set and vanish
+    managed2 = CheckpointManagerSaver(str(tmp_path / "managed"))
+    net.fit(X[:8], Y[:8])
+    managed2.save_latest_model(net, 0.3)
+    latest2 = managed2.get_latest_model()
+    assert latest2.iteration == net.iteration
+    assert params_equal(latest2.params, net.params)
+    managed2.manager.close()
+
+
+# -------------------------------------------------------------- preemption
+def _run_worker(mode, ckpt, out, kill=None, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    if kill:
+        env["RES_KILL_STEP"] = str(kill)
+    else:
+        env.pop("RES_KILL_STEP", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "resilience_worker.py"),
+         mode, ckpt, out],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_sigterm_preemption_checkpoint_and_reexec_resume(tmp_path):
+    """Satellite: SIGTERM mid-fit in a real subprocess -> the goodbye
+    checkpoint lands, re-exec resumes, final params equal the
+    uninterrupted run (bit-exact) and no step is recomputed."""
+    ckpt = str(tmp_path / "ckpt")
+    r1 = _run_worker("train", ckpt, str(tmp_path / "killed.npz"), kill=7)
+    assert r1.returncode == 143, (r1.stdout, r1.stderr)
+    assert "PREEMPTED step=7" in r1.stdout
+    assert any(n.startswith("ckpt-") for n in os.listdir(ckpt))
+
+    r2 = _run_worker("train", ckpt, str(tmp_path / "resumed.npz"))
+    assert r2.returncode == 0, (r2.stdout, r2.stderr)
+    r3 = _run_worker("baseline", str(tmp_path / "nockpt"),
+                     str(tmp_path / "base.npz"))
+    assert r3.returncode == 0, (r3.stdout, r3.stderr)
+
+    killed = np.load(str(tmp_path / "killed.npz"))
+    resumed = np.load(str(tmp_path / "resumed.npz"))
+    base = np.load(str(tmp_path / "base.npz"))
+    # the goodbye checkpoint was taken AT the preemption step: resume
+    # starts exactly there — zero lost work, zero recomputation
+    assert int(resumed["resumed"]) == 7
+    stitched = np.concatenate([killed["losses"][:7], resumed["losses"]])
+    assert np.array_equal(stitched, base["losses"])
+    pkeys = sorted(k for k in base.files if k.startswith("p"))
+    for k in pkeys:
+        assert np.array_equal(resumed[k], base[k]), k
+
+
+def test_sigterm_handler_restored_after_fit():
+    before = signal.getsignal(signal.SIGTERM)
+    trainer = ResilientTrainer(build_mln())
+    trainer.fit(mk_iterator(), num_epochs=1)
+    assert signal.getsignal(signal.SIGTERM) is before
