@@ -352,7 +352,7 @@ def bench_mxu_calibration(steps=10):
 
 
 def _transformer_bench_cfg(seq, d_model, n_layers, heads, vocab=8192,
-                           dtype_policy="performance"):
+                           dtype_policy="performance", remat="auto"):
     """Single source of truth for the bench transformer's architecture —
     bench_transformer runs it, transformer_hbm_preflight sizes it; sharing
     the builder keeps the OOM guard modeling the exact network it guards."""
@@ -361,12 +361,12 @@ def _transformer_bench_cfg(seq, d_model, n_layers, heads, vocab=8192,
     return TransformerConfig(
         vocab_size=vocab, d_model=d_model, n_layers=n_layers, n_heads=heads,
         d_ff=4 * d_model, max_len=seq, dtype_policy=dtype_policy,
-        learning_rate=1e-4,
+        learning_rate=1e-4, remat=remat,
     )
 
 
 def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
-                      steps=5, dtype_policy="performance"):
+                      steps=5, dtype_policy="performance", remat="auto"):
     """Decoder-only LM train throughput (models/transformer.py): the model
     family whose scale needs the parallelism stack. Runs the flash-attention
     pallas kernel when on TPU (ops/pallas_attention.py); MFU from
@@ -377,7 +377,7 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
     from deeplearning4j_tpu.models.transformer import TransformerLM
 
     cfg = _transformer_bench_cfg(seq, d_model, n_layers, heads,
-                                 dtype_policy=dtype_policy)
+                                 dtype_policy=dtype_policy, remat=remat)
     lm = TransformerLM(cfg)
     rng = np.random.default_rng(0)
     toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
@@ -447,76 +447,73 @@ def bench_transformer(batch=16, seq=1024, d_model=2048, n_layers=4, heads=32,
                              and flash_fits(seq, d_model // heads)),
         "batch": batch, "seq": seq, "d_model": d_model, "layers": n_layers,
         "dtype_policy": dtype_policy,
+        # resolved remat rung (ops/remat.py ladder) — measurement provenance
+        "remat": _resolved_remat(remat),
     }
 
 
+def _resolved_remat(remat) -> str:
+    from deeplearning4j_tpu.ops.remat import remat_policy
+
+    return remat_policy(remat)
+
+
 def transformer_hbm_preflight(batch, seq, d_model, n_layers, heads,
-                              vocab=8192, hbm_gb=16.0):
-    """CPU-side HBM estimate for one transformer training step — the guard
-    that keeps the MFU-chase leg (transformer_lm_big) from dying with an
-    OOM on first tunnel contact (an untested config must not waste the
+                              vocab=8192, hbm_gb=16.0, remat="none",
+                              accum_steps=1):
+    """HBM preflight for one transformer training step — the guard that
+    keeps the MFU-chase leg (transformer_lm_big) from dying with an OOM
+    on first tunnel contact (an untested config must not waste the
     round's one capture window).
 
-    Params and optimizer state are EXACT (jax.eval_shape on the real
-    init_params/init_opt_state — zero allocation, works without the chip);
-    activations are an analytic per-layer residual count for the bf16
-    policy with the flash kernel (q/k/v/attn-out/mlp-in/x ~6 [B,S,D]
-    buffers + 2 [B,S,d_ff] gelu buffers + flash o/lse), logits [B,S,V]
-    f32 x2 (fwd + softmax residual), all times a 1.25x slack factor for
-    XLA temps. Returns (fits, report_dict)."""
-    import jax
-
-    from deeplearning4j_tpu.models.transformer import (
-        init_opt_state,
-        init_params,
-    )
+    The accounting guts now live in the AOT memory plane
+    (ops/memory.transformer_preflight): params/optimizer/grads EXACT via
+    jax.eval_shape on the real inits; activations a remat- and
+    accum-aware analytic model of the bf16+flash regime (``remat`` picks
+    the ladder rung — none/dots/block, ops/remat.py); measured
+    memory_analysis numbers merged in when the config is small enough to
+    AOT-compile on the CPU substrate. Returns (fits, report_dict)."""
+    from deeplearning4j_tpu.ops.memory import transformer_preflight
 
     # the SAME config builder bench_transformer uses: the estimate must
     # model the exact network the leg will run, or the guard drifts
     cfg = _transformer_bench_cfg(seq, d_model, n_layers, heads, vocab,
-                                 dtype_policy="performance")
-    nbytes = lambda tree: sum(
-        int(np.prod(l.shape)) * l.dtype.itemsize
-        for l in jax.tree_util.tree_leaves(tree))
-    p_shapes = jax.eval_shape(lambda: init_params(cfg))
-    param_b = nbytes(p_shapes)
-    opt_b = nbytes(jax.eval_shape(init_opt_state, p_shapes))
-    grad_b = param_b  # one grad pytree materialized alongside the update
-    bsd = batch * seq * d_model
-    act_b = n_layers * 2 * (6 * bsd + 2 * batch * seq * 4 * d_model
-                            + bsd + 2 * batch * seq)  # bf16 = 2 bytes
-    logit_b = 2 * batch * seq * vocab * 4
-    total = (param_b + opt_b + grad_b + act_b + logit_b) * 1.25
-    report = {
-        "params_gb": round(param_b / 2**30, 2),
-        "opt_gb": round(opt_b / 2**30, 2),
-        "grads_gb": round(grad_b / 2**30, 2),
-        "activations_gb_est": round(act_b / 2**30, 2),
-        "logits_gb": round(logit_b / 2**30, 2),
-        "total_gb_est": round(total / 2**30, 2),
-        "hbm_gb": hbm_gb,
-        "batch": batch,
-    }
-    return total <= hbm_gb * 2**30, report
+                                 dtype_policy="performance", remat=remat)
+    return transformer_preflight(cfg, batch, accum_steps=accum_steps,
+                                 remat=remat, hbm_gb=hbm_gb)
 
 
 def bench_transformer_big(steps=3, seq=1024, d_model=2048, n_layers=8,
                           heads=32):
-    """The MFU-chase leg with the HBM preflight in front: largest batch in
-    {16, 8, 4} whose estimate fits this chip's 16GB, so the first on-chip
-    run can't OOM on an untested shape (VERDICT r03 weak #8)."""
+    """The MFU-chase leg with the HBM preflight in front: the auto-fit
+    sizer (ops/memory.auto_fit_transformer) picks the largest
+    (batch, remat policy) pair whose estimate fits this chip's 16GB —
+    largest batch first, weakest remat rung first (each rung down the
+    ladder costs backward recompute), so the first on-chip run can't OOM
+    on an untested shape (VERDICT r03 weak #8) and the b32 config that
+    exceeded HBM un-rematted (BENCH_NOTES round-2 ceiling) is attempted
+    WITH remat on the watcher's next contact."""
+    from deeplearning4j_tpu.ops.memory import auto_fit_transformer
+
     hbm_gb = float(os.environ.get("DL4J_TPU_HBM_GB", "16"))
-    report = None
-    for batch in (16, 8, 4):
-        fits, report = transformer_hbm_preflight(
-            batch, seq, d_model, n_layers, heads, hbm_gb=hbm_gb)
-        if fits:
-            break
-    else:
-        return {"error": "no candidate batch fits HBM", "preflight": report}
-    out = bench_transformer(batch=batch, seq=seq, d_model=d_model,
-                            n_layers=n_layers, heads=heads, steps=steps)
-    out["preflight"] = report
+    cfg = _transformer_bench_cfg(seq, d_model, n_layers, heads,
+                                 dtype_policy="performance")
+    # accum pinned to 1 for the leg: the MFU number must stay a
+    # one-dispatch-per-step measurement (accum changes the program shape)
+    choice = auto_fit_transformer(cfg, batches=(32, 16, 8, 4),
+                                  accum_steps=(1,), hbm_gb=hbm_gb)
+    if choice is None:
+        # keep the diagnostic: the per-component breakdown of the MOST
+        # affordable candidate says WHY nothing fit (triage from the
+        # artifact instead of re-running the preflight by hand)
+        _, report = transformer_hbm_preflight(
+            4, seq, d_model, n_layers, heads, hbm_gb=hbm_gb, remat="block")
+        return {"error": "no (batch, remat) candidate fits HBM",
+                "preflight": report}
+    out = bench_transformer(batch=choice["batch"], seq=seq, d_model=d_model,
+                            n_layers=n_layers, heads=heads, steps=steps,
+                            remat=choice["remat"])
+    out["preflight"] = choice["report"]
     return out
 
 
@@ -690,7 +687,12 @@ def feed(bucketing):
     return {"traces": s.traces.get("train_step", 0),
             "dispatches": s.calls.get("train_step", 0),
             "cache_hits": s.cache_hits("train_step"),
-            "padded_batches": s.padded_batches}
+            "padded_batches": s.padded_batches,
+            # wall-seconds spent in calls that traced (trace + XLA
+            # compile) — the per-program compile budget a short tunnel
+            # contact window has to plan around
+            "trace_seconds": round(s.trace_seconds.get("train_step", 0.0),
+                                   3)}
 
 bucketed = feed(True)
 unbucketed = feed(False)
@@ -743,6 +745,8 @@ print(json.dumps({
     "speedup_stat": "median of 3 interleaved pair ratios; committed "
                     "steps/sec are the median pair's own halves",
     "donated_steps_counted": int(donated_n),
+    "train_step_trace_seconds": round(
+        net_d.dispatch_stats.trace_seconds.get("train_step", 0.0), 3),
     "timed_steps": steps,
 }))
 """
@@ -766,6 +770,123 @@ def bench_dispatch_overhead(steps=40):
         parsed["note"] = (f"accelerator unreachable ({probe_err}); CPU "
                           "dispatch numbers — the retrace counts carry "
                           "over, the donation/steps-sec row needs the chip")
+    return parsed
+
+
+# ---------------------------------------------------------------------------
+# remat: AOT memory ladder + step-time overhead (CPU-measurable — the
+# tunnel-independent proof of the HBM-lean training PR)
+# ---------------------------------------------------------------------------
+
+_REMAT_SCRIPT = r"""
+import dataclasses, json, os, sys, time
+mode, steps = sys.argv[1], int(sys.argv[2])
+if mode == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deeplearning4j_tpu.models.transformer as tfm
+from deeplearning4j_tpu.ops import memory as mem
+
+# the d512 L8 evidence config (ISSUE 4 acceptance): big enough that the
+# activation ladder dominates temp bytes, small enough that the CPU
+# substrate compiles each rung in seconds. Strict f32 on CPU (bf16 is a
+# pessimization there); the chip regime (bf16) rides the same ladder.
+d, L, heads, seq, batch, vocab = 512, 8, 8, 256, 8, 8192
+dtype = "strict" if mode == "cpu" else "performance"
+cfg0 = tfm.TransformerConfig(
+    vocab_size=vocab, d_model=d, n_layers=L, n_heads=heads, d_ff=4 * d,
+    max_len=seq, dtype_policy=dtype, learning_rate=1e-4)
+
+rng = np.random.default_rng(0)
+toks = rng.integers(0, vocab, (batch, seq + 1))
+x = jax.device_put(jnp.asarray(toks[:, :-1], jnp.int32))
+y = jax.device_put(jnp.asarray(toks[:, 1:], jnp.int32))
+
+rows = {}
+for pol in ("none", "dots", "block"):
+    cfg = dataclasses.replace(cfg0, remat=pol)
+    step = tfm.make_train_step(cfg)
+    # ONE compile serves both the AOT ledger and the timed run (the
+    # ledger comes first: the memory claim must not depend on the timed
+    # run surviving)
+    p_sh = jax.eval_shape(lambda: tfm.init_params(cfg))
+    o_sh = jax.eval_shape(tfm.init_opt_state, p_sh)
+    compiled = step.lower(p_sh, o_sh, x, y).compile()
+    a = mem.analyze_compiled(compiled)
+    params = tfm.init_params(cfg)
+    opt = tfm.init_opt_state(params)
+    step = compiled  # the AOT executable IS the step from here on
+    params, opt, loss = step(params, opt, x, y)  # warm
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, x, y)
+    final = float(loss)  # host readback with a true data dependency —
+    # the only sound completion fence through the remote-TPU tunnel
+    rows[pol] = {
+        "temp_bytes": None if a is None else a["temp_bytes"],
+        "temp_gb": None if a is None else round(a["temp_bytes"] / 2**30, 3),
+        "peak_gb": None if a is None else round(a["peak_bytes"] / 2**30, 3),
+        "step_ms": round((time.perf_counter() - t0) / steps * 1000, 1),
+        "loss": round(final, 4),
+    }
+
+def ratio(num, den):
+    return None if not num or not den else round(num / den, 2)
+
+out = {
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "config": f"d{d} L{L} h{heads} b{batch} s{seq} v{vocab} {dtype}",
+    "timed_steps": steps,
+    "policies": rows,
+    # the headline: AOT temp bytes (activations + workspace) per rung
+    "temp_reduction_dots_x": ratio(rows["none"]["temp_bytes"],
+                                   rows["dots"]["temp_bytes"]),
+    "temp_reduction_block_x": ratio(rows["none"]["temp_bytes"],
+                                    rows["block"]["temp_bytes"]),
+    # recompute cost per rung (>1 = slower than none, the expected trade)
+    "step_overhead_dots": ratio(rows["dots"]["step_ms"],
+                                rows["none"]["step_ms"]),
+    "step_overhead_block": ratio(rows["block"]["step_ms"],
+                                 rows["none"]["step_ms"]),
+    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+}
+# committed artifact (the PALLAS_BENCH.json pattern): the ladder evidence
+# survives independently of the merged bench artifact
+tmp = "REMAT_MEMORY.json.tmp"
+with open(tmp, "w") as f:
+    json.dump(out, f, indent=1, sort_keys=True)
+os.replace(tmp, "REMAT_MEMORY.json")
+print(json.dumps(out))
+"""
+
+
+def bench_remat_memory(steps=2):
+    """Remat-ladder leg (ops/remat.py + ops/memory.py): AOT
+    ``memory_analysis`` temp bytes and measured step time for the d512 L8
+    train step under each remat rung (none/dots/block). CPU-measurable —
+    the AOT ledger is exactly as valid on the CPU substrate as on the
+    chip (it accounts the program XLA compiled for THAT backend) — with
+    an honest backend label either way; on-chip rows additionally report
+    real HBM. Writes REMAT_MEMORY.json beside the bench artifact. Runs
+    in a subprocess (fresh tunnel, the north-star reasoning)."""
+    probe_err = _probe_device(timeout_s=90.0)
+    mode = "cpu" if probe_err else "auto"
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _REMAT_SCRIPT, mode, str(steps)], 900)
+    if parsed is None:
+        return {"error": err}
+    if probe_err:
+        parsed["note"] = (f"accelerator unreachable ({probe_err}); CPU "
+                          "AOT memory ladder — the temp-bytes reductions "
+                          "are per-backend-program facts, the on-chip HBM "
+                          "row lands at next contact")
     return parsed
 
 
@@ -1060,6 +1181,12 @@ per_step = batch * steps / (time.perf_counter() - t0)
 # per step than the unfused fit on this host — measured during PR 2),
 # while on TPU the same program is the headline. The honest CPU-for-CPU
 # ratio is per-step vs per-step (the torch baseline is a per-step loop).
+# DL4J_TPU_FUSE=force: fit_batches now auto-falls back to per-step fits
+# for scanned conv on the CPU backend (dispatch.fusion_enabled — the
+# guard this measurement motivated); this row deliberately measures the
+# pessimized fused program itself, so it must force past the guard.
+import os
+os.environ["DL4J_TPU_FUSE"] = "force"
 k = 4
 xs = jax.device_put(np.broadcast_to(x, (k,) + x.shape).copy())
 ys = jax.device_put(np.broadcast_to(y, (k,) + y.shape).copy())
@@ -1568,7 +1695,8 @@ def _run_isolated(name: str, quick: bool, timeout_s: int = 0,
 # CPU-for-CPU baseline pair (forced jax-CPU by design).
 _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "native_feed", "dispatch_overhead", "serving_throughput",
-                  "checkpoint_overhead", "lenet5_cpu", "char_rnn_cpu"}
+                  "checkpoint_overhead", "lenet5_cpu", "char_rnn_cpu",
+                  "remat_memory"}
 
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_PARTIAL.json")
@@ -1741,7 +1869,7 @@ def main():
             elif name in ("scaling_virtual8", "north_star", "lstm_kernel",
                           "dispatch_overhead", "serving_throughput",
                           "checkpoint_overhead", "lenet5_cpu",
-                          "char_rnn_cpu"):
+                          "char_rnn_cpu", "remat_memory"):
                 # already subprocess-isolated internally
                 extras[name] = fn(*a, **kw)
             else:
@@ -1775,6 +1903,10 @@ def main():
     run("lenet5_fused", bench_lenet_fused, reps=1 if quick else 3)
     run("dispatch_overhead", bench_dispatch_overhead,
         steps=10 if quick else 40)
+    # remat ladder evidence: CPU-measurable (AOT memory_analysis), so a
+    # dead tunnel still yields the HBM-lean proof; early because the
+    # transformer_lm_big leg below TRUSTS the ladder it validates
+    run("remat_memory", bench_remat_memory, steps=1 if quick else 2)
     run("char_rnn", bench_char_rnn, steps=3 if quick else 10)
     run("word2vec_sgns", bench_word2vec, sentences=200 if quick else 800)
     run("transformer_lm", bench_transformer, steps=2 if quick else 5)

@@ -62,6 +62,10 @@ class BertConfig:
     # the rarest vocab word silently doubles as the mask marker.
     mask_token_id: Optional[int] = None
     seed: int = 0
+    # activation remat for the encoder block scan — the flagship's ladder
+    # (ops/remat.py, models/transformer.TransformerConfig.remat): "auto"
+    # defers to DL4J_TPU_REMAT; none/dots/block pin a rung
+    remat: str = "auto"
 
     @property
     def mask_id(self) -> int:
@@ -147,6 +151,11 @@ def encode(params: Params, tokens: jax.Array, cfg: BertConfig,
         return h + jax.nn.gelu(x @ bp["W1"] + bp["b1"]) @ bp["W2"] \
             + bp["b2"], None
 
+    from deeplearning4j_tpu.ops.remat import remat_wrap
+
+    # same remat ladder as the flagship's block scan (cfg.remat resolved
+    # at trace time; the MLM pretrain step traces through here)
+    block = remat_wrap(block, cfg.remat, prevent_cse=False)
     h, _ = lax.scan(block, h, params["blocks"])
     return _ln(h, params["lnf_g"], params["lnf_b"])
 
@@ -384,6 +393,23 @@ class BertMLM:
         self._logits = jax.jit(lambda p, t: mlm_logits(p, t, cfg))
         self._encode = jax.jit(lambda p, t: encode(p, t, cfg))
         self._rng = np.random.default_rng(cfg.seed)
+        from deeplearning4j_tpu.ops.memory import MemoryStats
+
+        # AOT memory ledger (ops/memory.py), populated by measure_memory
+        self.memory_stats = MemoryStats()
+
+    def measure_memory(self, inputs, targets,
+                       weights) -> Optional[dict]:
+        """AOT memory accounting for the MLM train step on this (already
+        masked) batch — lower + compile + memory_analysis, no execution;
+        recorded under 'train_step' in self.memory_stats."""
+        from deeplearning4j_tpu.ops import memory as memory_mod
+
+        return memory_mod.measure(
+            self.memory_stats, "train_step", self._step, self.params,
+            self.opt, jnp.asarray(inputs, jnp.int32),
+            jnp.asarray(targets, jnp.int32),
+            jnp.asarray(weights, jnp.float32))
 
     def fit(self, tokens) -> float:
         """One masked-LM step on a [N, T] int batch (masking re-drawn

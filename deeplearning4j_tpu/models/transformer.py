@@ -89,6 +89,18 @@ class TransformerConfig:
     # (the reference's GradientNormalization ClipL2PerParamType role —
     # nn/conf/GradientNormalization.java — for the flagship)
     clip_grad_norm: float = 0.0
+    # activation rematerialization for the block scan (ops/remat.py —
+    # the Chen et al. sublinear-memory ladder): "auto" defers to the
+    # DL4J_TPU_REMAT env knob (default none); "none" stores every
+    # activation; "dots" keeps matmul outputs and recomputes elementwise
+    # ops; "block" stores only the residual carry and recomputes the
+    # whole block in the backward pass. Resolved at step-factory TRACE
+    # time (the donation-policy discipline); composes with accum_steps
+    # (remat shrinks per-microbatch activations, accum shrinks the
+    # microbatch). Values are policy-invariant (remat==none is bit-exact
+    # on the forward; grads agree to recompute-reassociation tolerance —
+    # tests/test_remat.py).
+    remat: str = "auto"
 
     @property
     def compute_dtype(self):
@@ -351,6 +363,14 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             h = h + inner @ bp["W2"].astype(cdt) + bp["b2"].astype(cdt)
         return (h, aux), None
 
+    from deeplearning4j_tpu.ops.remat import remat_wrap
+
+    # remat policy ladder applied to the scan BODY (cfg.remat, resolved
+    # at trace time): under autodiff the scan stores only what the
+    # checkpoint policy saves per layer instead of every residual.
+    # prevent_cse=False: the scan's loop boundary already blocks the CSE
+    # the checkpoint barriers guard against (nn/common.remat_apply).
+    block = remat_wrap(block, cfg.remat, prevent_cse=False)
     (h, aux), _ = lax.scan(block, (h, jnp.zeros((), jnp.float32)),
                            params["blocks"])
     h = _ln(h.astype(jnp.float32), params["lnf_g"], params["lnf_b"])
@@ -1084,6 +1104,11 @@ class TransformerLM:
         self._step = self._make_step()
         self._gen_cache: Dict[tuple, Any] = {}
         self.iteration = 0
+        from deeplearning4j_tpu.ops.memory import MemoryStats
+
+        # AOT memory ledger beside the containers' dispatch_stats
+        # (ops/memory.py); populated on demand by measure_memory()
+        self.memory_stats = MemoryStats()
 
     def _pipeline_mode(self) -> bool:
         return self.mesh is not None and PIPELINE_AXIS in self.mesh.shape
@@ -1125,7 +1150,25 @@ class TransformerLM:
         # the optimizer step count IS the training iteration — restoring it
         # keeps the listener iteration contract across checkpoint resumes
         lm.iteration = int(lm.opt["t"])
+        from deeplearning4j_tpu.ops.memory import MemoryStats
+
+        lm.memory_stats = MemoryStats()
         return lm
+
+    def measure_memory(self, tokens: jax.Array,
+                       targets: jax.Array) -> Optional[Dict[str, Any]]:
+        """AOT memory accounting for the current train step on this batch
+        shape (ops/memory.analyze_jit: lower + compile + memory_analysis,
+        no execution) — recorded under 'train_step' in self.memory_stats.
+        On the CPU substrate this measures the CPU build; against the
+        chip it reports real HBM. Returns the byte dict, or None when the
+        backend exposes no memory stats."""
+        from deeplearning4j_tpu.ops import memory as memory_mod
+
+        return memory_mod.measure(
+            self.memory_stats, "train_step", self._step,
+            self.params, self.opt, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(targets, jnp.int32))
 
     def fit(self, tokens: jax.Array, targets: jax.Array) -> jax.Array:
         self.params, self.opt, loss = self._step(

@@ -73,9 +73,21 @@ def apply_layer(layer, conf, params, state, x, rng, mask, kwargs, *,
         # incoming activations so batch statistics / square-sums really
         # accumulate in f32 — merely skipping the downcast is not enough
         x = x.astype(jnp.float32)
-    if train and conf.gradient_checkpointing:
+    # per-layer remat is unified under the DL4J_TPU_REMAT policy ladder
+    # (ops/remat.py): the conf flag keeps its meaning — full per-layer
+    # remat, the ladder's "block" rung — and the env knob can switch any
+    # net's policy without a conf change ("dots" = keep matmul outputs,
+    # recompute elementwise). Resolved at trace time, like the donation
+    # policy.
+    from deeplearning4j_tpu.ops.remat import remat_policy
+
+    env_policy = remat_policy("auto")
+    effective = env_policy if env_policy != "none" else (
+        "block" if conf.gradient_checkpointing else "none")
+    if train and effective != "none":
         y, new_state = remat_apply(layer, params, state, x, rng, mask, kwargs,
-                                   prevent_cse=remat_prevent_cse)
+                                   prevent_cse=remat_prevent_cse,
+                                   policy=effective)
     else:
         y, new_state = layer.apply(params, state, x, train=train, rng=rng,
                                    mask=mask, **kwargs)
@@ -99,19 +111,25 @@ def cast_loss_input(x):
 
 
 def remat_apply(layer, params, state, x, rng, mask, kwargs,
-                prevent_cse: bool = True):
+                prevent_cse: bool = True, policy: str = "block"):
     """Apply a layer under jax.checkpoint: store only the layer INPUT and
     recompute its activations in the backward pass (dropout rng keys are
     counter-based, so recomputed masks are identical). prevent_cse=False
     is for callers whose remat sits inside a lax.scan body (fit_batches) —
     the loop boundary already blocks the CSE the barrier guards against,
-    so the default barriers would only cost fusion opportunities."""
+    so the default barriers would only cost fusion opportunities.
+    ``policy``: an active rung of the DL4J_TPU_REMAT ladder ("block" =
+    store the layer input only; "dots" = additionally keep this layer's
+    matmul outputs, recomputing only elementwise ops — ops/remat.py)."""
     import jax
+
+    from deeplearning4j_tpu.ops.remat import checkpoint_kwargs
 
     def _apply(p, s, xx, lr):
         return layer.apply(p, s, xx, train=True, rng=lr, mask=mask, **kwargs)
 
-    return jax.checkpoint(_apply, prevent_cse=prevent_cse)(
+    return jax.checkpoint(_apply, prevent_cse=prevent_cse,
+                          **checkpoint_kwargs(policy))(
         params, state, x, rng
     )
 

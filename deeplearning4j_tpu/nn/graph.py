@@ -88,6 +88,11 @@ class ComputationGraph:
         self._jit_cache: Dict[Any, Any] = {}
         self._input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None
         self.dispatch_stats = dispatch.DispatchStats()
+        from deeplearning4j_tpu.ops.memory import MemoryStats
+
+        # AOT memory ledger beside dispatch_stats (ops/memory.py) —
+        # populated on demand via the instrumented jits' .measure_memory
+        self.memory_stats = MemoryStats()
         # see MultiLayerNetwork: BN batch statistics would absorb pad rows
         self._bucketing_blocked = any(
             isinstance(v, conf_layers.BatchNormalization)
@@ -435,7 +440,7 @@ class ComputationGraph:
         # caller re-binds params/states/upd_state from the returned triple
         fn = dispatch.instrumented_jit(
             train_step, "train_step", self.dispatch_stats,
-            donate=(0, 1, 2), step=True)
+            donate=(0, 1, 2), step=True, mem_stats=self.memory_stats)
         self._jit_cache[key] = fn
         return fn
 
@@ -482,9 +487,35 @@ class ComputationGraph:
 
         fn = dispatch.instrumented_jit(
             scan_fn, "fit_batches", self.dispatch_stats,
-            donate=(0, 1, 2), step=True)
+            donate=(0, 1, 2), step=True, mem_stats=self.memory_stats)
         self._jit_cache[key] = fn
         return fn
+
+    def _has_scanned_conv(self) -> bool:
+        return any(isinstance(v, (conf_layers.ConvolutionLayer,
+                                  conf_layers.SubsamplingLayer))
+                   for v in self.conf.vertices.values())
+
+    def _fit_batches_fallback(self, features, labels):
+        """Per-step drain under the fusion policy (dispatch.fusion_enabled:
+        the XLA:CPU scan-of-conv ~15x pessimization, BENCH_NOTES round-6);
+        recorded in dispatch_stats.fused_fallbacks, DL4J_TPU_FUSE=force
+        overrides. Same contract as MultiLayerNetwork's fallback."""
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresIterationListener,
+        )
+
+        self.dispatch_stats.fused_fallbacks += 1
+        feats = [jnp.asarray(f) for f in _as_list(features)]
+        labs = [jnp.asarray(l) for l in _as_list(labels)]
+        col = CollectScoresIterationListener(frequency=1)
+        self.listeners.append(col)
+        try:
+            for k in range(feats[0].shape[0]):
+                self.fit([f[k] for f in feats], [l[k] for l in labs])
+        finally:
+            self.listeners.remove(col)
+        return np.asarray([s for _, s in col.scores], np.float32)
 
     def fit_batches(self, features, labels):
         """Fit each leading-axis slice ([K, N, ...]) inside a single
@@ -504,6 +535,8 @@ class ComputationGraph:
             raise ValueError(
                 f"expected {len(self.conf.outputs)} label arrays, got {len(labels_l)}"
             )
+        if not dispatch.fusion_enabled(scanned_conv=self._has_scanned_conv()):
+            return self._fit_batches_fallback(features, labels)
         fn = self._get_fit_batches_fn(len(labels_l))
         self.params, self.states, self.updater_state, losses = fn(
             self.params, self.states, self.updater_state,
@@ -805,7 +838,8 @@ class ComputationGraph:
                 return [acts[o] for o in self.conf.outputs]
 
             self._jit_cache[key] = dispatch.instrumented_jit(
-                out_fn, "output", self.dispatch_stats)
+                out_fn, "output", self.dispatch_stats,
+                mem_stats=self.memory_stats)
         return self._jit_cache[key]
 
     def output(self, *features) -> List[jax.Array]:

@@ -71,6 +71,12 @@ class MultiLayerNetwork:
         self._jit_cache: Dict[Any, Any] = {}
         self._input_shape: Optional[Tuple[int, ...]] = None
         self.dispatch_stats = dispatch.DispatchStats()
+        from deeplearning4j_tpu.ops.memory import MemoryStats
+
+        # AOT memory ledger beside dispatch_stats (ops/memory.py) —
+        # populated on demand via measure_memory / .measure_memory on the
+        # instrumented jits, never implicitly on the hot path
+        self.memory_stats = MemoryStats()
         # batch-statistics layers make shape bucketing unsound in training:
         # the pad rows would enter the BN batch mean/var (loss masking
         # cannot undo that), so fit() skips bucketing for these nets
@@ -296,9 +302,25 @@ class MultiLayerNetwork:
         # training state each step
         fn = dispatch.instrumented_jit(
             train_step, "train_step", self.dispatch_stats,
-            donate=(0, 1, 2), step=True)
+            donate=(0, 1, 2), step=True, mem_stats=self.memory_stats)
         self._jit_cache[key] = fn
         return fn
+
+    def measure_memory(self, features, labels, mask=None, label_mask=None):
+        """AOT memory accounting for this net's train step on the given
+        batch shape (ops/memory: lower + compile + memory_analysis, no
+        execution) — recorded under 'train_step' in self.memory_stats.
+        Returns the byte dict, or None when the backend exposes no
+        memory stats."""
+        if self.params is None:
+            self.init()
+        features = jnp.asarray(features)
+        labels = jnp.asarray(labels)
+        step = self._get_train_step(mask is not None, label_mask is not None)
+        return step.measure_memory(
+            self.params, self.states, self.updater_state, features, labels,
+            jnp.asarray(self.iteration, jnp.int32), self._rng, mask,
+            label_mask)
 
     def _get_output_fn(self, train: bool = False):
         key = ("output", train)
@@ -309,7 +331,8 @@ class MultiLayerNetwork:
                 return acts[-1]
 
             self._jit_cache[key] = dispatch.instrumented_jit(
-                out_fn, "output", self.dispatch_stats)
+                out_fn, "output", self.dispatch_stats,
+                mem_stats=self.memory_stats)
         return self._jit_cache[key]
 
     def _get_score_fn(self, has_mask: bool, has_label_mask: bool):
@@ -483,9 +506,37 @@ class MultiLayerNetwork:
         # params/states/upd_state from the scan's outputs
         fn = dispatch.instrumented_jit(
             scan_fn, "fit_batches", self.dispatch_stats,
-            donate=(0, 1, 2), step=True)
+            donate=(0, 1, 2), step=True, mem_stats=self.memory_stats)
         self._jit_cache[key] = fn
         return fn
+
+    def _has_scanned_conv(self) -> bool:
+        return any(isinstance(lc, (conf_layers.ConvolutionLayer,
+                                   conf_layers.SubsamplingLayer))
+                   for lc in self.conf.layers)
+
+    def _fit_batches_fallback(self, features, labels, masks, label_masks):
+        """Per-step drain for fit_batches when the fusion policy says the
+        scanned program would lose (dispatch.fusion_enabled: XLA:CPU
+        pessimizes scan-of-conv ~15x, BENCH_NOTES round-6). Semantics are
+        identical by construction — fit_batches is DEFINED as equivalent
+        to K fit() calls — and the fallback is recorded in
+        dispatch_stats.fused_fallbacks; DL4J_TPU_FUSE=force overrides."""
+        from deeplearning4j_tpu.optimize.listeners import (
+            CollectScoresIterationListener,
+        )
+
+        self.dispatch_stats.fused_fallbacks += 1
+        col = CollectScoresIterationListener(frequency=1)
+        self.listeners.append(col)
+        try:
+            for k in range(features.shape[0]):
+                self.fit(features[k], labels[k],
+                         masks[k] if masks is not None else None,
+                         label_masks[k] if label_masks is not None else None)
+        finally:
+            self.listeners.remove(col)
+        return np.asarray([s for _, s in col.scores], np.float32)
 
     def fit_batches(self, features, labels, masks=None, label_masks=None):
         """Fit each leading-axis slice of ``features`` [K, N, ...] /
@@ -502,6 +553,11 @@ class MultiLayerNetwork:
             raise ValueError("fit_batches supports SGD-family training only")
         features = jnp.asarray(features)
         labels = jnp.asarray(labels)
+        if not dispatch.fusion_enabled(scanned_conv=self._has_scanned_conv()):
+            return self._fit_batches_fallback(
+                features, labels,
+                jnp.asarray(masks) if masks is not None else None,
+                jnp.asarray(label_masks) if label_masks is not None else None)
         fn = self._get_fit_batches_fn(masks is not None, label_masks is not None)
         zeros = jnp.zeros((features.shape[0],), jnp.float32)
         self.params, self.states, self.updater_state, losses = fn(

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import defaultdict
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -55,6 +56,7 @@ import numpy as np
 ENV_DONATE = "DL4J_TPU_DONATE"
 ENV_BUCKET = "DL4J_TPU_BUCKET_BATCHES"
 ENV_CACHE = "DL4J_TPU_COMPILE_CACHE"
+ENV_FUSE = "DL4J_TPU_FUSE"
 
 _OFF = ("0", "off", "false", "no")
 _ON = ("1", "on", "true", "yes", "force")
@@ -90,6 +92,38 @@ def donation_enabled() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# fusion policy (fit_batches' scan-of-steps)
+# ---------------------------------------------------------------------------
+
+
+def fusion_enabled(scanned_conv: bool = False) -> bool:
+    """Should fit_batches fuse K steps into one lax.scan program?
+
+    Fusion is the dispatch-amortization win everywhere EXCEPT scanned
+    conv programs on XLA:CPU, which the backend pessimizes ~15x vs the
+    per-step program (measured, BENCH_NOTES round-6 — the CPU-for-CPU
+    lenet5 row quotes the per-step number for exactly this reason). The
+    containers pass ``scanned_conv=True`` when the net has conv/
+    subsampling layers; on the CPU substrate that falls back to per-step
+    fits (recorded in ``DispatchStats.fused_fallbacks``). The env knob
+    ``DL4J_TPU_FUSE`` overrides: ``force`` (or any _ON value) always
+    fuses — the equivalence tests and the lenet5_cpu leg pin the fused
+    program with it — and ``0`` never does. Reads the
+    ``jax_platforms`` CONFIG, never the backend (the donation-policy
+    rationale: jax.default_backend() would initialize the axon plugin,
+    which hangs on a dead tunnel)."""
+    v = os.environ.get(ENV_FUSE, "").strip().lower()
+    if v in _ON:  # "force" and its _ON siblings ("1"/"on"/...) all pin fusion
+        return True
+    if v in _OFF:
+        return False
+    if not scanned_conv:
+        return True
+    platforms = jax.config.jax_platforms
+    return not (platforms and platforms.split(",")[0] == "cpu")
+
+
+# ---------------------------------------------------------------------------
 # telemetry
 # ---------------------------------------------------------------------------
 
@@ -111,15 +145,27 @@ class DispatchStats:
       padded_batches / padded_examples
                      shape-bucketing activity (fit calls that padded, and
                      the total pad rows fed)
+      trace_seconds[name]
+                     wall-seconds spent in calls that TRACED (trace +
+                     XLA compile + the first dispatch per shape) — the
+                     compile-time ledger for tunnel-window triage: a
+                     short contact window budgeted against these numbers
+                     knows which programs it can afford to warm
+      fused_fallbacks
+                     fit_batches calls that fell back to per-step fits
+                     under the fusion policy (fusion_enabled: the
+                     XLA:CPU scan-of-conv pessimization guard)
     """
 
     def __init__(self) -> None:
         self.traces: Dict[str, int] = defaultdict(int)
         self.calls: Dict[str, int] = defaultdict(int)
+        self.trace_seconds: Dict[str, float] = defaultdict(float)
         self.donated_steps = 0
         self.copied_steps = 0
         self.padded_batches = 0
         self.padded_examples = 0
+        self.fused_fallbacks = 0
 
     def cache_hits(self, name: Optional[str] = None) -> int:
         if name is not None:
@@ -131,16 +177,20 @@ class DispatchStats:
             "traces": dict(self.traces),
             "calls": dict(self.calls),
             "cache_hits": {n: self.cache_hits(n) for n in self.calls},
+            "trace_seconds": {n: round(s, 3)
+                              for n, s in self.trace_seconds.items()},
             "donated_steps": self.donated_steps,
             "copied_steps": self.copied_steps,
             "padded_batches": self.padded_batches,
             "padded_examples": self.padded_examples,
+            "fused_fallbacks": self.fused_fallbacks,
         }
 
 
 def instrumented_jit(fn, name: str, stats: DispatchStats, *,
                      donate: Sequence[int] = (),
-                     static_argnums=None, step: bool = False):
+                     static_argnums=None, step: bool = False,
+                     mem_stats=None):
     """``jax.jit`` with retrace/dispatch telemetry and policy-gated donation.
 
     ``donate``: argnums to donate WHEN the donation policy is on; the
@@ -152,8 +202,16 @@ def instrumented_jit(fn, name: str, stats: DispatchStats, *,
 
     ``step=True`` marks a training step for the donated/copied counters.
 
+    ``mem_stats``: an ops/memory.MemoryStats to receive AOT byte
+    accounting; the wrapper's ``.measure_memory(*args)`` lowers +
+    compiles WITHOUT executing and records the analysis under ``name``
+    (the memory plane beside this dispatch plane — never paid implicitly
+    on the hot path).
+
     The returned wrapper exposes ``.lower`` (bench cost-analysis uses it)
-    and ``.donated_argnums`` (tests assert the policy).
+    and ``.donated_argnums`` (tests assert the policy). Calls that trace
+    also accrue wall-seconds into ``stats.trace_seconds[name]`` (trace +
+    compile + first dispatch — the compile-time triage ledger).
     """
     enable_compile_cache()
     donated: Tuple[int, ...] = tuple(donate) if (
@@ -180,7 +238,16 @@ def instrumented_jit(fn, name: str, stats: DispatchStats, *,
                 stats.donated_steps += 1
             else:
                 stats.copied_steps += 1
-        return jfn(*args, **kwargs)
+        before = stats.traces[name]
+        t0 = time.perf_counter()
+        out = jfn(*args, **kwargs)
+        if stats.traces[name] > before:
+            # this call traced: its wall time is dominated by trace+XLA
+            # compile (dispatch itself returns async) — the per-trace
+            # compile-cost ledger the DispatchStatsListener and the
+            # dispatch_overhead leg surface for tunnel-window triage
+            stats.trace_seconds[name] += time.perf_counter() - t0
+        return out
 
     def lower(*args, **kwargs):
         # cost-analysis lowering (bench legs) must not skew the
@@ -192,7 +259,16 @@ def instrumented_jit(fn, name: str, stats: DispatchStats, *,
         finally:
             counting[0] = True
 
+    def measure_memory(*args, **kwargs):
+        from deeplearning4j_tpu.ops import memory as memory_mod
+
+        analysis = memory_mod.analyze_lowered(lower(*args, **kwargs))
+        if mem_stats is not None and analysis is not None:
+            mem_stats.record(name, analysis)
+        return analysis
+
     wrapper.lower = lower
+    wrapper.measure_memory = measure_memory
     wrapper.donated_argnums = donated
     wrapper._jitted = jfn
     wrapper.__name__ = f"jit_{name}"

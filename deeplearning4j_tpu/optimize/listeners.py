@@ -64,11 +64,13 @@ class DispatchStatsListener(IterationListener):
         snap = dict(stats.snapshot(), iteration=iteration)
         self.snapshots.append(snap)
         logger.info(
-            "iteration %d dispatch: traces=%s cache_hits=%d donated=%d "
-            "copied=%d padded_batches=%d",
-            iteration, dict(snap["traces"]), sum(snap["cache_hits"].values()),
+            "iteration %d dispatch: traces=%s trace_secs=%.3f cache_hits=%d "
+            "donated=%d copied=%d padded_batches=%d fused_fallbacks=%d",
+            iteration, dict(snap["traces"]),
+            sum(snap["trace_seconds"].values()),
+            sum(snap["cache_hits"].values()),
             snap["donated_steps"], snap["copied_steps"],
-            snap["padded_batches"],
+            snap["padded_batches"], snap["fused_fallbacks"],
         )
 
 

@@ -35,9 +35,16 @@ def main():
     stoi = {c: i for i, c in enumerate(chars)}
     ids = np.array([stoi[c] for c in TEXT], np.int32)
 
+    # activation-remat knob (ops/remat.py ladder): DL4J_TPU_REMAT picks
+    # none/dots/block; the `-m examples` smoke tier pins "block" so the
+    # remat path is exercised end-to-end on every smoke run
+    remat = os.environ.get("DL4J_TPU_REMAT") or ("block" if SMOKE else "auto")
     cfg = TransformerConfig(vocab_size=len(chars), d_model=64, n_layers=2,
                             n_heads=4, d_ff=128, max_len=64,
-                            learning_rate=3e-3)
+                            learning_rate=3e-3, remat=remat)
+    from deeplearning4j_tpu.ops.remat import remat_policy
+
+    print("remat policy:", remat_policy(cfg.remat))
     mesh = device_mesh(shape=(2, 4), axis_names=("data", "model"))
     lm = TransformerLM(cfg, mesh=mesh)
 

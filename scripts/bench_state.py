@@ -29,8 +29,8 @@ import sys
 # run() calls so a new leg can't silently escape the completeness check
 EXPECTED = [
     "mxu_calibration", "lenet5", "lenet5_fused", "dispatch_overhead",
-    "char_rnn", "word2vec_sgns", "transformer_lm", "resnet50",
-    "resnet50_bf16", "transformer_lm_big", "flash_attention",
+    "remat_memory", "char_rnn", "word2vec_sgns", "transformer_lm",
+    "resnet50", "resnet50_bf16", "transformer_lm_big", "flash_attention",
     "ring_attention", "lstm_kernel", "north_star", "serving_throughput",
     "checkpoint_overhead", "reference_cpu_lenet5_torch", "lenet5_cpu",
     "char_rnn_cpu", "native_feed", "scaling_virtual8",

@@ -61,6 +61,8 @@ _QUICK_FILES = {
     "test_gradient_check.py",
     "test_multilayer.py",
     "test_dispatch.py",
+    # remat==no-remat value contracts + the AOT memory ladder (ISSUE 4)
+    "test_remat.py",
     # the whole resilience suite (incl. the subprocess SIGTERM preemption
     # leg, ~6s) fits the quick budget — crash-recovery is exactly the kind
     # of contract a mid-round change can silently break

@@ -44,3 +44,37 @@ class TestTransformerHbmPreflight:
                                                     vocab=1024)
         assert fits
         assert rep["total_gb_est"] < 1.0
+
+    def test_b32_d2048_accepted_under_remat(self):
+        """ISSUE 4 acceptance: the b32 config that exceeded usable HBM
+        un-rematted (BENCH_NOTES round-2 ceiling) is accepted under a
+        remat rung — armed for the next tunnel window."""
+        fits_none, _ = bench.transformer_hbm_preflight(32, 1024, 2048, 8, 32)
+        fits_block, rep = bench.transformer_hbm_preflight(
+            32, 1024, 2048, 8, 32, remat="block")
+        assert not fits_none
+        assert fits_block
+        assert rep["remat"] == "block" and rep["batch"] == 32
+
+    def test_auto_fit_arms_b32_with_remat(self):
+        """The transformer_lm_big ladder: auto-fit keeps the largest
+        batch by climbing the remat ladder instead of shrinking to b16."""
+        from deeplearning4j_tpu.ops.memory import auto_fit_transformer
+
+        cfg = bench._transformer_bench_cfg(1024, 2048, 8, 32)
+        choice = auto_fit_transformer(cfg, batches=(32, 16, 8, 4),
+                                      accum_steps=(1,), hbm_gb=16.0)
+        assert choice is not None
+        assert choice["batch"] == 32
+        assert choice["remat"] in ("dots", "block")
+
+    def test_accum_shrinks_activation_estimate(self):
+        """accum_steps sizes activations/logits per microbatch (and
+        doubles the grad tree) — the composing axis of the auto-fit
+        sizer."""
+        _, rep1 = bench.transformer_hbm_preflight(16, 1024, 2048, 8, 32)
+        _, rep4 = bench.transformer_hbm_preflight(16, 1024, 2048, 8, 32,
+                                                  accum_steps=4)
+        assert rep4["activations_gb_est"] < rep1["activations_gb_est"]
+        assert rep4["logits_gb"] < rep1["logits_gb"]
+        assert rep4["grads_gb"] == 2 * rep1["grads_gb"]

@@ -393,3 +393,131 @@ def test_compile_cache_env_off(monkeypatch):
     monkeypatch.setenv(dispatch.ENV_CACHE, "0")
     assert dispatch.compile_cache_dir() is None
     assert dispatch.enable_compile_cache("/tmp/ignored") is None
+
+
+# ---------------------------------------------------------------------------
+# fusion policy: the XLA:CPU scan-of-conv guard (ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+def _tiny_conv_net(seed=11):
+    from deeplearning4j_tpu.nn.conf import (
+        ConvolutionLayer,
+        SubsamplingLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.preprocessors import (
+        CnnToFeedForwardPreProcessor,
+    )
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(0.05)
+        .updater("sgd")
+        .weight_init("xavier")
+        .list()
+        .layer(0, ConvolutionLayer(n_in=1, n_out=3, kernel_size=(3, 3),
+                                   stride=(1, 1), activation="relu"))
+        .layer(1, SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                   stride=(2, 2)))
+        .layer(2, OutputLayer(n_in=3 * 3 * 3, n_out=2, activation="softmax",
+                              loss_function="mcxent"))
+        .input_preprocessor(2, CnnToFeedForwardPreProcessor(3, 3, 3))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init(input_shape=(8, 8, 1))
+
+
+def _conv_data(k=2, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.standard_normal((k, n, 8, 8, 1)).astype(np.float32)
+    ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (k, n))]
+    return xs, ys
+
+
+class TestScanOfConvGuard:
+    def test_policy_unit(self, monkeypatch):
+        monkeypatch.delenv(dispatch.ENV_FUSE, raising=False)
+        # non-conv programs always fuse; conv-in-scan is CPU-gated
+        assert dispatch.fusion_enabled(scanned_conv=False)
+        assert not dispatch.fusion_enabled(scanned_conv=True)  # CPU substrate
+        monkeypatch.setenv(dispatch.ENV_FUSE, "force")
+        assert dispatch.fusion_enabled(scanned_conv=True)
+        monkeypatch.setenv(dispatch.ENV_FUSE, "1")  # _ON siblings == force
+        assert dispatch.fusion_enabled(scanned_conv=True)
+        monkeypatch.setenv(dispatch.ENV_FUSE, "0")
+        assert not dispatch.fusion_enabled(scanned_conv=False)
+
+    def test_conv_fit_batches_falls_back_per_step(self, monkeypatch):
+        """On the CPU backend a conv fit_batches drains through per-step
+        fit() (the measured ~15x XLA:CPU scan-of-conv pessimization,
+        BENCH_NOTES round-6) with IDENTICAL semantics — fit_batches is
+        defined as K serial fits — and the fallback is visible in
+        dispatch_stats."""
+        monkeypatch.delenv(dispatch.ENV_FUSE, raising=False)
+        xs, ys = _conv_data()
+
+        serial = _tiny_conv_net()
+        serial_losses = [float(serial.fit(xs[k], ys[k]))
+                         for k in range(xs.shape[0])]
+
+        net = _tiny_conv_net()
+        losses = net.fit_batches(xs, ys)
+        assert net.dispatch_stats.fused_fallbacks == 1
+        # the scanned program was never built, the per-step one was
+        assert net.dispatch_stats.traces.get("fit_batches", 0) == 0
+        assert net.dispatch_stats.traces.get("train_step", 0) >= 1
+        np.testing.assert_allclose(losses, serial_losses, rtol=1e-6)
+        assert net.iteration == serial.iteration == xs.shape[0]
+        for p_s, p_f in zip(serial.params, net.params):
+            for name in p_s:
+                np.testing.assert_allclose(
+                    np.asarray(p_f[name]), np.asarray(p_s[name]),
+                    rtol=1e-6, atol=1e-7, err_msg=name)
+
+    def test_force_keeps_fused_program(self, monkeypatch):
+        monkeypatch.setenv(dispatch.ENV_FUSE, "force")
+        xs, ys = _conv_data()
+        net = _tiny_conv_net()
+        losses = net.fit_batches(xs, ys)
+        assert losses.shape == (xs.shape[0],)
+        assert net.dispatch_stats.fused_fallbacks == 0
+        assert net.dispatch_stats.traces.get("fit_batches", 0) == 1
+
+    def test_dense_nets_unaffected(self, monkeypatch):
+        monkeypatch.delenv(dispatch.ENV_FUSE, raising=False)
+        x, y = _data(24)
+        net = mlp()
+        losses = net.fit_batches(np.stack([x[:12], x[12:]]),
+                                 np.stack([y[:12], y[12:]]))
+        assert losses.shape == (2,)
+        assert net.dispatch_stats.fused_fallbacks == 0
+        assert net.dispatch_stats.traces.get("fit_batches", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-trace wall-seconds (compile-time triage telemetry, ISSUE 4 satellite)
+# ---------------------------------------------------------------------------
+
+class TestTraceSeconds:
+    def test_trace_seconds_accrue_only_on_traces(self):
+        net = mlp()
+        x, y = _data(32)
+        net.fit(x, y)
+        s = net.dispatch_stats
+        first = s.trace_seconds.get("train_step", 0.0)
+        assert first > 0.0
+        net.fit(x, y)  # cache hit: no new trace, no new seconds
+        assert s.trace_seconds["train_step"] == first
+        net.fit(x[:16], y[:16])  # new shape: retrace accrues more
+        assert s.trace_seconds["train_step"] > first
+
+    def test_snapshot_and_listener_carry_trace_seconds(self):
+        net = mlp()
+        x, y = _data(16)
+        lst = DispatchStatsListener(frequency=1)
+        net.listeners.append(lst)
+        net.fit(x, y)
+        snap = net.dispatch_stats.snapshot()
+        assert snap["trace_seconds"]["train_step"] > 0.0
+        assert snap["fused_fallbacks"] == 0
+        assert lst.snapshots and "trace_seconds" in lst.snapshots[-1]
