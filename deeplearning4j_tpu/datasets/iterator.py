@@ -255,14 +255,31 @@ class ListDataSetIterator(DataSetIterator):
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch with a bounded queue (reference
     AsyncDataSetIterator.java:30). Overlaps host-side batch assembly and
-    host->device transfer with device compute."""
+    host->device transfer with device compute.
+
+    Queue depth defaults from ``DL4J_TPU_PREFETCH`` (the knob shared with
+    ``etl/pipeline.InputPipeline``; an explicit ``queue_size`` wins), and
+    the iterator carries ``pipeline_stats`` — the same telemetry shape as
+    the full pipeline (etl/stats.PipelineStats: producer stall = the
+    prefetch thread blocked on a full queue, consumer stall = the
+    training thread starved waiting on it), so ingest health reads the
+    same regardless of which staging wrapper fed the fit."""
 
     _SENTINEL = object()
 
-    def __init__(self, base: DataSetIterator, queue_size: int = 2, device_put: bool = True):
+    def __init__(self, base: DataSetIterator,
+                 queue_size: Optional[int] = None, device_put: bool = True):
+        from deeplearning4j_tpu.etl.stats import PipelineStats
+
+        if queue_size is None:
+            from deeplearning4j_tpu.etl.pipeline import default_prefetch
+
+            queue_size = default_prefetch()
         self.base = base
         self.queue_size = max(1, int(queue_size))
         self.device_put = device_put
+        self.pipeline_stats = PipelineStats(workers=1,
+                                            queue_capacity=self.queue_size)
         # resume cursor of the batch most recently DELIVERED to the
         # consumer — NOT base.state(), which runs ahead by however many
         # batches sit prefetched in the queue (those would be silently
@@ -271,18 +288,35 @@ class AsyncDataSetIterator(DataSetIterator):
         # its batch.
         self._last_state: Optional[dict] = None
 
-    def _put(self, q: "queue.Queue", stop: threading.Event, item) -> bool:
+    def _put(self, q: "queue.Queue", stop: threading.Event, item,
+             timed: bool = True) -> bool:
         """Bounded put that gives up when the consumer abandoned iteration
-        (prevents the producer thread hanging in q.put forever)."""
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        (prevents the producer thread hanging in q.put forever). Time
+        spent blocked on a full queue is the PRODUCER stall (healthy:
+        the trainer is the bottleneck, not the feed). The end-of-stream
+        sentinel passes ``timed=False``: it waits for the consumer to
+        DRAIN the queue, which is not feed-side starvation — counting it
+        would inflate producer_stall by ~queue_size steps per pass (the
+        InputPipeline stager's sentinel is likewise untimed)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        finally:
+            if timed:
+                self.pipeline_stats.add_producer_stall(
+                    _time.perf_counter() - t0)
 
     def _producer(self, q: "queue.Queue", stop: threading.Event):
+        from deeplearning4j_tpu.etl.stats import dataset_nbytes
+
         try:
             for ds in self.base:
                 if stop.is_set():
@@ -290,6 +324,10 @@ class AsyncDataSetIterator(DataSetIterator):
                 # resume snapshot for THIS batch (base only ever touched
                 # from this thread, so the read is race-free)
                 snap = self.base.state()
+                # byte/record counts on the HOST arrays, BEFORE staging
+                # (counting a device array would force a readback)
+                nbytes = dataset_nbytes(ds)
+                n = ds.num_examples()
                 if self.device_put:
                     ds = DataSet(
                         jax.device_put(ds.features),
@@ -301,30 +339,38 @@ class AsyncDataSetIterator(DataSetIterator):
                         if ds.labels_mask is None
                         else jax.device_put(ds.labels_mask),
                     )
-                if not self._put(q, stop, (ds, snap)):
+                if not self._put(q, stop, (ds, snap, nbytes, n)):
                     return
         finally:
-            self._put(q, stop, self._SENTINEL)
+            self._put(q, stop, self._SENTINEL, timed=False)
 
     def __iter__(self):
+        import time as _time
+
         # before any batch is delivered, the resume point is wherever the
         # base stands now (fresh pass or a restored cursor)
         self._last_state = self.base.state()
+        stats = self.pipeline_stats
+        stats.start_pass()
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
         stop = threading.Event()
         t = threading.Thread(target=self._producer, args=(q, stop), daemon=True)
         t.start()
         try:
             while True:
+                t0 = _time.perf_counter()
                 item = q.get()
+                stats.add_consumer_stall(_time.perf_counter() - t0)
                 if item is self._SENTINEL:
                     break
-                ds, snap = item
+                ds, snap, nbytes, n = item
                 self._last_state = snap
+                stats.record_delivered(nbytes, n, q.qsize())
                 yield ds
         finally:
             stop.set()
             t.join(timeout=5.0)
+            stats.end_pass()
 
     def reset(self):
         self._last_state = None

@@ -70,8 +70,17 @@ class LineRecordReader(RecordReader):
 
 
 class CSVRecordReader(RecordReader):
-    """Numeric/text CSV records (Canova CSVRecordReader: skipNumLines +
-    delimiter)."""
+    """CSV records (Canova CSVRecordReader: skipNumLines + delimiter),
+    RFC-4180 aware: quoted fields may contain the delimiter, doubled
+    quotes, and embedded newlines (stdlib ``csv`` does the state
+    machine). ``skip_lines`` skips the first N RECORDS (header rows;
+    identical to physical lines except when a quoted field spans lines).
+
+    Ragged rows fail LOUDLY: every record must have the width of the
+    first record, else ``ValueError`` with file + line number — the old
+    behavior (yield the short row, die later inside ``float()`` during
+    batch assembly with no provenance) debugged as a shape error three
+    layers away from the bad byte."""
 
     def __init__(self, path: str, skip_lines: int = 0, delimiter: str = ",",
                  encoding: str = "utf-8"):
@@ -81,13 +90,27 @@ class CSVRecordReader(RecordReader):
         self.encoding = encoding
 
     def __iter__(self):
-        with open(self.path, "r", encoding=self.encoding) as f:
-            for i, line in enumerate(f):
+        import csv
+
+        # newline="" is the csv-module contract: IT handles newlines, so
+        # quoted embedded "\r\n" survives intact
+        with open(self.path, "r", encoding=self.encoding, newline="") as f:
+            rdr = csv.reader(f, delimiter=self.delimiter, quotechar='"',
+                             doublequote=True)
+            width = None
+            for i, rec in enumerate(rdr):
                 if i < self.skip_lines:
                     continue
-                line = line.strip()
-                if line:
-                    yield line.split(self.delimiter)
+                if not rec or (len(rec) == 1 and not rec[0].strip()):
+                    continue  # blank line
+                if width is None:
+                    width = len(rec)
+                elif len(rec) != width:
+                    raise ValueError(
+                        f"{self.path}:{rdr.line_num}: ragged row — "
+                        f"{len(rec)} fields, expected {width} "
+                        f"(first data row's width)")
+                yield rec
 
 
 class SequenceRecordReader:

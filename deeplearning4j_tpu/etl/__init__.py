@@ -1,0 +1,45 @@
+"""DataVec-parity ETL subsystem (L5): transform plane, fitted
+normalizers, and the overlapped InputPipeline runtime.
+
+The reference outsources its whole ingest plane to Canova/DataVec
+(SURVEY.md section 2.1 — record readers, record->minibatch assembly,
+transforms; ~7.5k LoC the framework "must therefore provide"). The thin
+readers live in ``datasets/``; this package is the plane ABOVE them:
+
+  schema/transforms   typed columns + TransformProcess compiled to one
+                      executable record function (DataVec parity);
+  normalize           fitted DataNormalization (standardize / min-max /
+                      image scaler) with fit/transform/revert and
+                      checkpoint-zip serde;
+  pipeline            InputPipeline: parallel transform + vectorized
+                      assembly off the training thread, deterministic
+                      order, double-buffered device staging,
+                      checkpointable delivered-batch cursor;
+  stats               PipelineStats telemetry (net.pipeline_stats).
+"""
+
+from deeplearning4j_tpu.etl.normalize import (
+    DataNormalization,
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    normalizer_from_json,
+)
+from deeplearning4j_tpu.etl.pipeline import InputPipeline, maybe_wrap
+from deeplearning4j_tpu.etl.schema import ColumnType, Schema
+from deeplearning4j_tpu.etl.stats import PipelineStats
+from deeplearning4j_tpu.etl.transforms import TransformProcess
+
+__all__ = [
+    "ColumnType",
+    "DataNormalization",
+    "ImagePreProcessingScaler",
+    "InputPipeline",
+    "NormalizerMinMaxScaler",
+    "NormalizerStandardize",
+    "PipelineStats",
+    "Schema",
+    "TransformProcess",
+    "maybe_wrap",
+    "normalizer_from_json",
+]

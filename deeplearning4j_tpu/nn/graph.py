@@ -93,6 +93,9 @@ class ComputationGraph:
         # AOT memory ledger beside dispatch_stats (ops/memory.py) —
         # populated on demand via the instrumented jits' .measure_memory
         self.memory_stats = MemoryStats()
+        # ingest telemetry (etl/stats.py), adopted by fit_iterator from a
+        # staged iterator — see MultiLayerNetwork.pipeline_stats
+        self.pipeline_stats = None
         # see MultiLayerNetwork: BN batch statistics would absorb pad rows
         self._bucketing_blocked = any(
             isinstance(v, conf_layers.BatchNormalization)
@@ -759,9 +762,18 @@ class ComputationGraph:
         DataSets/MultiDataSets through fit_batches (one XLA program per K
         optimizer steps — MultiLayerNetwork.fit_iterator's fused path for
         the DAG container). Per-step fallback for masks, shape changes,
-        ragged tails, TBPTT and non-SGD solvers."""
+        ragged tails, TBPTT and non-SGD solvers.
+
+        Input staging: DL4J_TPU_PIPELINE_WORKERS wraps a plain iterator
+        in etl/pipeline.InputPipeline and the staged iterator's telemetry
+        is adopted as ``net.pipeline_stats`` (see MultiLayerNetwork)."""
         if self.params is None:
             self.init()
+        from deeplearning4j_tpu.etl.pipeline import maybe_wrap
+
+        iterator = maybe_wrap(iterator)
+        if getattr(iterator, "pipeline_stats", None) is not None:
+            self.pipeline_stats = iterator.pipeline_stats
         fused = (fused_batches > 1
                  and self.conf.backprop_type != "truncated_bptt"
                  and self.conf.optimization_algo

@@ -77,6 +77,10 @@ class MultiLayerNetwork:
         # populated on demand via measure_memory / .measure_memory on the
         # instrumented jits, never implicitly on the hot path
         self.memory_stats = MemoryStats()
+        # ingest telemetry beside dispatch/memory stats (etl/stats.py):
+        # adopted from the staged iterator the last fit_iterator consumed
+        # (InputPipeline / AsyncDataSetIterator); None for direct fits
+        self.pipeline_stats = None
         # batch-statistics layers make shape bucketing unsound in training:
         # the pad rows would enter the BN batch mean/var (loss masking
         # cannot undo that), so fit() skips bucketing for these nets
@@ -660,9 +664,20 @@ class MultiLayerNetwork:
         steps instead of K dispatches (~5ms each through the remote-TPU
         tunnel; the lenet5_fused bench leg measures the win). Falls back
         to per-step fit() for ragged tails, shape changes, mixed mask
-        presence, and TBPTT (whose window loop fit() already handles)."""
+        presence, and TBPTT (whose window loop fit() already handles).
+
+        Input staging: ``DL4J_TPU_PIPELINE_WORKERS`` > 0 wraps a plain
+        iterator in ``etl/pipeline.InputPipeline`` (parallel off-thread
+        assembly + device staging; value-identical stream, so the
+        equivalence contracts hold); whichever staged iterator feeds the
+        loop, its telemetry is adopted as ``net.pipeline_stats``."""
         if self.params is None:
             self.init()
+        from deeplearning4j_tpu.etl.pipeline import maybe_wrap
+
+        iterator = maybe_wrap(iterator)
+        if getattr(iterator, "pipeline_stats", None) is not None:
+            self.pipeline_stats = iterator.pipeline_stats
         if self.conf.pretrain:
             self.pretrain(iterator)
             if hasattr(iterator, "reset"):

@@ -63,7 +63,7 @@ def _env_float(name: str, default: float) -> float:
 
 class ServingEngine:
     def __init__(self, model=None, model_path: Optional[str] = None,
-                 port: int = 0, input_shape=None, *,
+                 port: int = 0, input_shape=None, *, normalizer=None,
                  max_batch: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  queue_capacity: Optional[int] = None,
@@ -95,9 +95,13 @@ class ServingEngine:
         self._lock = threading.Lock()       # naive path + generate serialization
         self._engine_lock = threading.Lock()  # batcher/decoder creation
         if model is not None or model_path is not None:
+            # normalizer: explicit wins; a checkpoint zip's own section
+            # otherwise (registry.load reads it) — /predict then applies
+            # the exact statistics the model was trained under
             rec = self.registry.load("default", model=model,
                                      model_path=model_path,
-                                     input_shape=input_shape)
+                                     input_shape=input_shape,
+                                     normalizer=normalizer)
             self.registry.serve(rec.name, rec.version)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           self._make_handler())
@@ -165,12 +169,30 @@ class ServingEngine:
         return np.asarray(out)
 
     # -- internals --------------------------------------------------------
+    @staticmethod
+    def _normalize_rows(rec, x: np.ndarray) -> np.ndarray:
+        """Apply the record's fitted normalizer (etl/normalize.py) to the
+        request rows — the PURE array form (a batcher-coalesced batch
+        shares buffers across requests; in-place would corrupt peers).
+        Row-wise normalization commutes with batching, so the batched and
+        naive paths stay byte-equivalent. Runs AFTER the input_shape
+        reshape: statistics are per-final-axis (etl/normalize
+        ``_column_stats_axes``), so they were fitted at the shape the
+        trainer fed the net — per-channel for an image net, per-feature
+        for a flat one. Normalizing the flat wire rows would broadcast
+        (B, H*W*C) against per-channel stats and fail (or silently
+        mis-scale) for any shaped-input model."""
+        if rec.normalizer is None:
+            return x
+        return rec.normalizer.transform_array(x)
+
     def _direct_output(self, rec, x: np.ndarray) -> np.ndarray:
         """The naive per-request path the batcher replaces (kept for the
         DL4J_TPU_SERVE_BATCH=0 comparison and the bench's baseline): one
         locked output() dispatch per call."""
         if rec.input_shape is not None:
             x = x.reshape((x.shape[0],) + rec.input_shape)
+        x = self._normalize_rows(rec, x)
         with self._lock:
             out = rec.model.output(x)
         out0 = out[0] if isinstance(out, (list, tuple)) else out
@@ -183,10 +205,11 @@ class ServingEngine:
                 shape = rec.input_shape
                 model = rec.model
 
-                def infer(batch, _model=model, _shape=shape):
+                def infer(batch, _rec=rec, _model=model, _shape=shape):
+                    batch = np.asarray(batch)
                     if _shape is not None:
-                        batch = np.asarray(batch).reshape(
-                            (batch.shape[0],) + _shape)
+                        batch = batch.reshape((batch.shape[0],) + _shape)
+                    batch = self._normalize_rows(_rec, batch)
                     out = _model.output(batch)
                     out0 = out[0] if isinstance(out, (list, tuple)) else out
                     return np.asarray(out0)

@@ -48,12 +48,16 @@ class ModelRecord:
 
     def __init__(self, name: str, version: int, model, *,
                  input_shape: Optional[Tuple[int, ...]] = None,
-                 path: Optional[str] = None) -> None:
+                 path: Optional[str] = None, normalizer=None) -> None:
         self.name = name
         self.version = int(version)
         self.model = model
         self.input_shape = tuple(input_shape) if input_shape else None
         self.path = path
+        # fitted DataNormalization (etl/normalize.py) applied to every
+        # /predict request for this record — the training-time statistics
+        # travel WITH the model (checkpoint zip normalizer.json section)
+        self.normalizer = normalizer
         self.state = "loaded"
         self.loaded_ts = time.strftime("%Y-%m-%dT%H:%M:%S")
         self.warmed_buckets: List[int] = []
@@ -74,6 +78,8 @@ class ModelRecord:
         }
         if self.input_shape:
             out["input_shape"] = list(self.input_shape)
+        if self.normalizer is not None:
+            out["normalizer"] = type(self.normalizer).__name__
         stats = getattr(self.model, "dispatch_stats", None)
         if stats is not None:
             out["dispatch_stats"] = stats.snapshot()
@@ -88,20 +94,30 @@ class ModelRegistry:
 
     # -- lifecycle --------------------------------------------------------
     def load(self, name: str, model=None, model_path: Optional[str] = None,
-             input_shape=None) -> ModelRecord:
+             input_shape=None, normalizer=None) -> ModelRecord:
         """Register a live model or restore a ModelSerializer zip; the
-        version is auto-assigned (monotonic per name, starting at 1)."""
+        version is auto-assigned (monotonic per name, starting at 1).
+        A checkpoint zip's optional normalizer section is picked up
+        automatically (an explicit ``normalizer`` wins) so /predict
+        applies the exact statistics the model trained under."""
         if model is None:
             if model_path is None:
                 raise ValueError("need model or model_path")
             from deeplearning4j_tpu.utils.serialization import ModelSerializer
 
             model = ModelSerializer.restore(model_path)
+        if normalizer is None and model_path is not None:
+            from deeplearning4j_tpu.utils.serialization import (
+                read_normalizer,
+            )
+
+            normalizer = read_normalizer(model_path)
         with self._lock:
             versions = self._records.setdefault(name, {})
             version = max(versions) + 1 if versions else 1
             rec = ModelRecord(name, version, model,
-                              input_shape=input_shape, path=model_path)
+                              input_shape=input_shape, path=model_path,
+                              normalizer=normalizer)
             versions[version] = rec
             # NOT auto-promoted to the traffic default: only serve()
             # switches traffic (the documented load -> warmup -> serve

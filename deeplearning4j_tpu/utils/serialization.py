@@ -16,6 +16,15 @@ restore): a ZIP holding
                          only when the caller supplies it (resilience/
                          CheckpointManager does), and old zips without the
                          entry keep loading unchanged.
+  normalizer.json      — OPTIONAL fitted DataNormalization statistics
+                         (etl/normalize.py). The reference serializes its
+                         normalizers SEPARATELY from the model
+                         (NormalizerSerializer), which is how serving and
+                         training statistics drift apart; riding the model
+                         zip makes them one artifact — serving
+                         (serving/registry.py) and resume apply the SAME
+                         statistics the model was trained under. Old zips
+                         without the entry keep loading unchanged.
 
 Parameters are stored leaf-by-leaf keyed by their pytree path (the pytree
 replaces the reference's single flat param vector; keys make the format
@@ -35,6 +44,7 @@ import numpy as np
 FORMAT_VERSION = 1
 
 TRAINING_STATE_ENTRY = "training_state.json"
+NORMALIZER_ENTRY = "normalizer.json"
 
 
 def _jsonable_training_state(ts: Dict[str, Any]) -> Dict[str, Any]:
@@ -56,6 +66,7 @@ def write_model_parts(
     updater_state=None,
     meta: dict = None,
     training_state: dict = None,
+    normalizer=None,
     compression: int = zipfile.ZIP_DEFLATED,
 ) -> None:
     """The single zip writer every checkpoint path shares. ``write_model``
@@ -78,6 +89,8 @@ def write_model_parts(
         if training_state is not None:
             z.writestr(TRAINING_STATE_ENTRY,
                        json.dumps(_jsonable_training_state(training_state)))
+        if normalizer is not None:
+            z.writestr(NORMALIZER_ENTRY, normalizer.to_json())
         z.writestr("metadata.json", json.dumps(meta))
 
 
@@ -88,6 +101,26 @@ def read_training_state(path: str) -> Dict[str, Any] | None:
         if TRAINING_STATE_ENTRY not in z.namelist():
             return None
         return json.loads(z.read(TRAINING_STATE_ENTRY).decode())
+
+
+def read_normalizer(path: str):
+    """The optional fitted-normalizer section of a checkpoint zip
+    (etl/normalize.py statistics), or None when absent — every
+    pre-normalizer zip and the sharded orbax DIRECTORY format (which has
+    no such section) load unchanged. This is how serving
+    (serving/registry.ModelRegistry.load) and resume pick up the exact
+    training-time statistics."""
+    import os
+
+    if os.path.isdir(path) or not zipfile.is_zipfile(path):
+        return None
+    with zipfile.ZipFile(path, "r") as z:
+        if NORMALIZER_ENTRY not in z.namelist():
+            return None
+        payload = z.read(NORMALIZER_ENTRY).decode()
+    from deeplearning4j_tpu.etl.normalize import normalizer_from_json
+
+    return normalizer_from_json(payload)
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -175,11 +208,14 @@ class ModelSerializer:
 
     @staticmethod
     def write_model(net, path: str, save_updater: bool = True,
-                    training_state: dict = None) -> None:
+                    training_state: dict = None, normalizer=None) -> None:
         """`training_state` (optional): the exact-resume section — pass
         ``net.training_state()`` (possibly extended with epoch/iterator
         cursor) to make the zip resumable without drift; omitted, the zip
-        is the original reference-shaped three-part checkpoint."""
+        is the original reference-shaped three-part checkpoint.
+        `normalizer` (optional): the fitted DataNormalization the model
+        was trained under — serving/resume read it back via
+        ``read_normalizer`` so inference applies the SAME statistics."""
         write_model_parts(
             path,
             model_class=type(net).__name__,
@@ -189,6 +225,7 @@ class ModelSerializer:
             updater_state=(net.updater_state if save_updater else None),
             meta=ModelSerializer._container_meta(net),
             training_state=training_state,
+            normalizer=normalizer,
         )
 
     @staticmethod

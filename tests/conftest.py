@@ -67,6 +67,11 @@ _QUICK_FILES = {
     # leg, ~6s) fits the quick budget — crash-recovery is exactly the kind
     # of contract a mid-round change can silently break
     "test_resilience.py",
+    # ETL plane (ISSUE 5): transform/normalizer value contracts plus the
+    # pipeline==serial byte-equivalence and kill/resume-through-pipeline
+    # contracts — both files run in seconds on tiny nets
+    "test_etl.py",
+    "test_input_pipeline.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and

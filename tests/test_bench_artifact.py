@@ -177,3 +177,16 @@ def test_remat_memory_leg_registered():
 
     assert "remat_memory" in EXPECTED
     assert "remat_memory" in expected_legs()
+
+
+def test_input_pipeline_leg_registered():
+    """ISSUE 5: the input_pipeline leg (naive single-thread feed vs the
+    overlapped InputPipeline, CPU-measurable) is in the expected set AND
+    in bench.py's CPU-only set — the ingest proof must run (and persist)
+    even with the tunnel dead."""
+    from scripts.bench_state import EXPECTED, expected_legs
+
+    assert "input_pipeline" in EXPECTED
+    assert "input_pipeline" in expected_legs()
+    m = _load_bench()
+    assert "input_pipeline" in m._CPU_ONLY_LEGS
