@@ -63,6 +63,11 @@ WORKERS_ENV = "DL4J_TPU_PIPELINE_WORKERS"
 PREFETCH_ENV = "DL4J_TPU_PREFETCH"
 
 _SENTINEL = object()
+_NO_PENDING = object()
+
+#: reshard() value for a member that LEFT the fleet: the pipeline owns
+#: nothing from the boundary on (None would mean "own everything")
+DROP_SHARD = "drop"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -218,6 +223,19 @@ class InputPipeline(DataSetIterator):
             idx, count = self.shard
             if not 0 <= idx < count:
                 raise ValueError(f"shard index {idx} outside [0, {count})")
+        # live resharding plane (ISSUE 6): a schedule of (at_seq, shard)
+        # entries over ABSOLUTE batch sequence numbers — the elastic
+        # fleet re-partitions the multihost shard selection on a
+        # membership epoch bump, at a boundary every member agrees on,
+        # so the union of the survivors' pipelines still covers every
+        # batch exactly once. Guarded by _shard_lock (the dispatcher
+        # thread reads it per batch).
+        self._shard_lock = threading.Lock()
+        self._shard_schedule: List[Tuple[int, Any]] = [(0, self.shard)]
+        self._pending_shard: Any = _NO_PENDING
+        self._dispatch_seq = 0  # ownership decided for seqs below this
+        self._consumed_seq = 0  # high-water mark of the last pass
+        self._pass_active = False
         self._reader_cfg = _reader_cfg
         if _reader_cfg is not None:
             head, tail = (None, None)
@@ -274,6 +292,92 @@ class InputPipeline(DataSetIterator):
         if hasattr(self.source, "reset"):
             self.source.reset()
 
+    # -- live resharding ---------------------------------------------------
+    def reshard(self, shard, *, at_seq: Optional[int] = None) -> None:
+        """Re-partition the multihost shard selection LIVE (the elastic
+        fleet's membership-epoch hook). ``shard`` is ``(index, count)``,
+        ``None`` (no sharding — own every batch), or :data:`DROP_SHARD`
+        (a departed member: own nothing from the boundary on).
+
+        ``at_seq`` anchors the change to an ABSOLUTE batch sequence
+        number — every member must pass the same boundary (the agreed
+        first batch of the next membership epoch), which is what keeps
+        the union of the fleet's pipelines covering every batch exactly
+        once, deterministically, with the delivered-batch cursor
+        semantics intact (batches below the boundary keep the old
+        partition; `state()` snapshots the schedule so a kill/resume
+        replays the identical ownership). Raises when the dispatcher
+        already decided ownership past the boundary — a retroactive
+        reshard could double- or zero-own an in-flight batch.
+
+        ``at_seq=None`` defers the change to the start of the NEXT pass
+        (the between-epochs form)."""
+        if shard is not None and shard != DROP_SHARD:
+            idx, count = shard
+            if not 0 <= idx < count:
+                raise ValueError(f"shard index {idx} outside [0, {count})")
+            shard = (int(idx), int(count))
+        with self._shard_lock:
+            if at_seq is None:
+                self._pending_shard = shard
+                return
+            at_seq = int(at_seq)
+            if self._pass_active and at_seq < self._dispatch_seq:
+                raise ValueError(
+                    f"reshard boundary {at_seq} already passed (dispatcher "
+                    f"at {self._dispatch_seq}) — a retroactive reshard "
+                    "would drop or double-own in-flight batches; pick a "
+                    "boundary ahead of the stream")
+            self._shard_schedule = (
+                [(s, sh) for s, sh in self._shard_schedule if s < at_seq]
+                + [(at_seq, shard)])
+
+    def _owns(self, abs_seq: int) -> bool:
+        """Shard ownership of batch `abs_seq` under the live schedule
+        (last entry at or below the sequence number wins)."""
+        with self._shard_lock:
+            self._dispatch_seq = max(self._dispatch_seq, abs_seq + 1)
+            shard = self._shard_schedule[0][1]
+            for s, sh in self._shard_schedule:
+                if s <= abs_seq:
+                    shard = sh
+                else:
+                    break
+        if shard == DROP_SHARD:
+            return False
+        return shard is None or abs_seq % shard[1] == shard[0]
+
+    def _begin_pass(self, resumed: bool) -> None:
+        """Fresh passes compact the boundaries the PREVIOUS pass consumed
+        (they must not re-fire at the restarted sequence numbers) down to
+        their final effective shard, while boundaries scheduled ahead of
+        the stream stay armed; a pending next-pass reshard lands now.
+        Resumed passes keep the restored schedule verbatim — ownership
+        must replay identically."""
+        with self._shard_lock:
+            if not resumed:
+                if self._pending_shard is not _NO_PENDING:
+                    self._shard_schedule = [(0, self._pending_shard)]
+                    self._pending_shard = _NO_PENDING
+                else:
+                    cut = self._consumed_seq
+                    past = [e for e in self._shard_schedule if e[0] <= cut]
+                    future = [e for e in self._shard_schedule if e[0] > cut]
+                    self._shard_schedule = [(0, past[-1][1])] + future
+            self._dispatch_seq = 0
+            self._pass_active = True
+
+    def _shard_schedule_snapshot(self) -> list:
+        with self._shard_lock:
+            return [[s, list(sh) if isinstance(sh, tuple) else sh]
+                    for s, sh in self._shard_schedule]
+
+    def _restore_shard_schedule(self, snap) -> None:
+        with self._shard_lock:
+            self._shard_schedule = [
+                (int(s), tuple(sh) if isinstance(sh, list) else sh)
+                for s, sh in snap]
+
     # -- resume protocol ---------------------------------------------------
     def state(self) -> Optional[dict]:
         """Cursor of the last batch DELIVERED to the consumer (never the
@@ -283,20 +387,44 @@ class InputPipeline(DataSetIterator):
         stateless sources) re-reads the stream and skips the delivered
         prefix — deterministic either way."""
         if self._last_state is not None:
-            return dict(self._last_state)
-        if self._resume is not None:  # restored but not yet iterated
-            return dict(self._resume)
-        # pass not started: defer to a resumable source's own cursor
-        if self._reader_cfg is None and hasattr(self.source, "state"):
+            out = dict(self._last_state)
+        elif self._resume is not None:  # restored but not yet iterated
+            out = dict(self._resume)
+        elif self._reader_cfg is None and hasattr(self.source, "state"):
+            # pass not started: defer to a resumable source's own cursor
             snap = self.source.state()
-            if snap is not None:
-                return {"mode": "source", "source": snap, "next_seq": 0}
-        return {"mode": "replay", "next_seq": 0}
+            out = ({"mode": "source", "source": snap, "next_seq": 0}
+                   if snap is not None
+                   else {"mode": "replay", "next_seq": 0})
+        else:
+            out = {"mode": "replay", "next_seq": 0}
+        # the live shard schedule rides the cursor: resumed ownership
+        # must replay identically across a membership-epoch reshard —
+        # including a deferred (next-pass) reshard not yet applied.
+        # ONE lock acquisition for both reads: a reshard landing between
+        # two acquisitions would leave the cursor missing a boundary the
+        # surviving pipelines applied
+        with self._shard_lock:
+            out["shard_schedule"] = [
+                [s, list(sh) if isinstance(sh, tuple) else sh]
+                for s, sh in self._shard_schedule]
+            if self._pending_shard is not _NO_PENDING:
+                sh = self._pending_shard
+                out["pending_shard"] = (list(sh) if isinstance(sh, tuple)
+                                        else sh)
+        return out
 
     def restore_state(self, state: dict) -> None:
         self._resume = dict(state)
         self._last_state = None
         self.pipeline_stats.record_restore()
+        if state.get("shard_schedule"):
+            self._restore_shard_schedule(state["shard_schedule"])
+        if "pending_shard" in state:
+            sh = state["pending_shard"]
+            with self._shard_lock:
+                self._pending_shard = (tuple(sh) if isinstance(sh, list)
+                                       else sh)
         if (state.get("mode") == "source"
                 and state.get("source") is not None):
             self.source.restore_state(state["source"])
@@ -304,6 +432,7 @@ class InputPipeline(DataSetIterator):
     # -- iteration ---------------------------------------------------------
     def __iter__(self):
         resume, self._resume = self._resume, None
+        self._begin_pass(resumed=resume is not None)
         seq_base = 0
         skip_below = 0
         if resume is not None:
@@ -358,6 +487,9 @@ class InputPipeline(DataSetIterator):
                 coord.cond.notify_all()
             for t in threads:
                 t.join(timeout=5.0)
+            with self._shard_lock:
+                self._pass_active = False
+                self._consumed_seq = self._dispatch_seq
             stats.end_pass()
         if delivered_clean and hasattr(self.source, "reset") \
                 and self._reader_cfg is not None:
@@ -367,8 +499,8 @@ class InputPipeline(DataSetIterator):
     def _local_batches(self, seq_base: int, skip_below: int):
         """(local_idx, abs_seq, payload, cursor) for every batch this
         process owns. Reads the SOURCE serially — the only stream-order-
-        dependent stage — and snapshots the resume cursor per batch."""
-        shard = self.shard
+        dependent stage — and snapshots the resume cursor per batch.
+        Ownership consults the LIVE shard schedule per batch (reshard)."""
         local = 0
         if self._reader_cfg is not None:
             cfg = self._reader_cfg
@@ -389,20 +521,20 @@ class InputPipeline(DataSetIterator):
                         continue
                 chunk.append(rec)
                 if len(chunk) == bs:
-                    if self._owns(abs_seq, shard) and abs_seq >= skip_below:
+                    if self._owns(abs_seq) and abs_seq >= skip_below:
                         yield emit(chunk, abs_seq, local)
                         local += 1
                     abs_seq += 1
                     chunk = []
             if chunk:
-                if self._owns(abs_seq, shard) and abs_seq >= skip_below:
+                if self._owns(abs_seq) and abs_seq >= skip_below:
                     yield emit(chunk, abs_seq, local)
         else:
             abs_seq = seq_base
             can_state = hasattr(self.source, "state")
             for ds in self.source:
                 snap = self.source.state() if can_state else None
-                if self._owns(abs_seq, shard) and abs_seq >= skip_below:
+                if self._owns(abs_seq) and abs_seq >= skip_below:
                     if snap is not None:
                         cursor = {"mode": "source", "source": snap,
                                   "next_seq": abs_seq + 1}
@@ -411,10 +543,6 @@ class InputPipeline(DataSetIterator):
                     yield (local, abs_seq, ds, cursor)
                     local += 1
                 abs_seq += 1
-
-    @staticmethod
-    def _owns(abs_seq: int, shard: Optional[Tuple[int, int]]) -> bool:
-        return shard is None or abs_seq % shard[1] == shard[0]
 
     def _dispatcher(self, coord, stop, work_q, seq_base, skip_below):
         stats = self.pipeline_stats

@@ -74,6 +74,37 @@ class DispatchStatsListener(IterationListener):
         )
 
 
+class ResilienceStatsListener(IterationListener):
+    """Surface the fault-plane telemetry (``net.resilience_stats`` —
+    transient-step retries + accumulated backoff, fleet split reclaims,
+    membership epoch/retries, preemptions/resumes; written by
+    resilience/trainer.ResilientTrainer and
+    parallel/fleet.ElasticParameterAveragingTrainer) through the listener
+    chain every N iterations, beside DispatchStatsListener — worker loss
+    and retry storms become visible in the same place score and retraces
+    already are (the reference's Spark training-stats role,
+    dl4j-spark/.../stats/StatsUtils.java:65)."""
+
+    def __init__(self, frequency: int = 100):
+        self.frequency = max(1, int(frequency))
+        self.snapshots: List[dict] = []
+
+    def iteration_done(self, model, iteration, score):
+        stats = getattr(model, "resilience_stats", None)
+        if stats is None or iteration % self.frequency != 0:
+            return
+        snap = dict(stats, iteration=iteration)
+        self.snapshots.append(snap)
+        logger.info(
+            "iteration %d resilience: retries=%d backoff=%.2fs reclaims=%d "
+            "epoch=%s stale_completions=%s preemptions=%s resumes=%s",
+            iteration, snap.get("retries", 0),
+            snap.get("backoff_seconds", 0.0), snap.get("reclaims", 0),
+            snap.get("epoch", "-"), snap.get("stale_completions", "-"),
+            snap.get("preemptions", "-"), snap.get("resumes", "-"),
+        )
+
+
 class PerformanceListener(IterationListener):
     """Throughput tracking (samples/sec) — TPU-side equivalent of the Spark
     stats instrumentation (SURVEY.md section 5 'Tracing/profiling')."""

@@ -14,14 +14,28 @@ section 2.7) with ONE mesh-based engine:
     dl4j-spark/.../ParameterAveragingTraining-    param/updater pmean every
     Master.java:402-434)                          k minibatches (exact
                                                   reference semantics)
-  Akka/Hazelcast Hogwild (legacy)               not reproduced (superseded)
+  Akka/Hazelcast Hogwild (legacy)               statetracker.py job/heartbeat
+                                                  plane, promoted to the
+                                                  elastic fleet's membership
+                                                  authority (fleet.py)
+  Spark cluster fault tolerance (lineage +      ElasticParameterAveraging-
+    heartbeat-tracked workers, job reclaim)       Trainer: preemption-tolerant
+                                                  N-worker averaging, rounds
+                                                  re-form over survivors,
+                                                  bit-exact vs a replay of
+                                                  the membership schedule
 
 Multi-host: the same Mesh spans hosts via jax.distributed; collectives ride
-ICI within a slice and DCN across slices — no Spark/Akka control plane.
+ICI within a slice and DCN across slices — the ELASTIC control plane
+(fleet membership, split reclaim) rides the statetracker transports.
 """
 
 from deeplearning4j_tpu.parallel.mesh import device_mesh
 from deeplearning4j_tpu.parallel.data_parallel import (
     ParallelWrapper,
     ParameterAveragingTrainer,
+)
+from deeplearning4j_tpu.parallel.fleet import (  # noqa: F401
+    ElasticParameterAveragingTrainer,
+    FileMembershipBoard,
 )

@@ -268,6 +268,88 @@ class ParallelWrapper:
         return self.net
 
 
+def stack_rounds(a, averaging_frequency: int):
+    """[freq*gb, ...] -> [freq, gb, ...] minibatch stacking (the
+    reference's one-split-feeds-freq-minibatches rule,
+    ParameterAveragingTrainingMaster.java:148). ONE copy shared by the
+    mesh trainer and the elastic fleet — the stacking rule must stay
+    identical or the ==serial / bit-exact-replay contracts silently
+    diverge between the two trainers."""
+    if a is None:
+        return None
+    a = jnp.asarray(a)
+    if a.ndim >= 2 and a.shape[0] != averaging_frequency:
+        gb = a.shape[0] // averaging_frequency
+        a = a[: gb * averaging_frequency].reshape(
+            (averaging_frequency, gb) + a.shape[1:])
+    return a
+
+
+def round_step_rngs(net, averaging_frequency: int):
+    """The round's per-step RNG keys [freq, 2] — every worker of a round
+    consumes the SAME sequence (the shard_map trainer replicates it;
+    the fleet ships it in the round state), derived from the net's key
+    at the current iteration. Shared for the same reason as
+    stack_rounds."""
+    return jax.vmap(lambda i: rng_mod.step_key(net._rng, i))(
+        jnp.arange(net.iteration, net.iteration + averaging_frequency))
+
+
+def container_calls(net):
+    """The two container-specific callables every parameter-averaging
+    worker needs — the loss invocation and the updater application —
+    for either container (the reference drives MLN and CG through the
+    same ParameterAveragingTrainingMaster). Returns
+    ``(loss_call, update_call, is_graph)``; shared by the shard_map
+    trainer below and the elastic fleet (parallel/fleet.py)."""
+    if hasattr(net, "_as_inputs"):  # ComputationGraph
+        return (
+            lambda p, st, x, y, r, m, lm: net._loss(
+                p, st, x, y, train=True, rng=r, masks=m or None,
+                label_masks=lm),
+            net._update_all,
+            True,
+        )
+    return (
+        lambda p, st, x, y, r, m, lm: net._loss(
+            p, st, x, y, train=True, rng=r, mask=m, label_mask=lm),
+        net.updater.update,
+        False,
+    )
+
+
+def local_round_scan(net, loss_call, update_call):
+    """The UNsynchronized device-side half of one averaging worker:
+    `averaging_frequency` independent train steps scanned over this
+    worker's minibatches from the broadcast params (processMinibatch on
+    executors, ExecuteWorkerFlatMap.java:35-100). Returns
+    ``(params, states, upd_state, iteration), losses``. Two consumers:
+    ParameterAveragingTrainer wraps it in shard_map and closes the round
+    with a pmean (single-controller mesh path); the elastic fleet
+    (parallel/fleet.py) jits it bare, per split, and averages the
+    survivor results on the host — which is what makes a round's outcome
+    a deterministic function of (broadcast params, split data) alone,
+    independent of WHICH worker executed the split."""
+
+    def worker(params, states, upd_state, xs, ys, ms, lms, iteration, rngs):
+        def body(carry, inp):
+            params, st, upd_state, it = carry
+            (x, y, m, lm), r = inp
+            (loss, new_states), grads = jax.value_and_grad(
+                lambda p: loss_call(p, st, x, y, r, m, lm), has_aux=True
+            )(params)
+            updates, upd_state2 = update_call(grads, upd_state, params, it)
+            params = apply_updates(params, updates, net.conf.minimize)
+            return (params, new_states, upd_state2, it + 1), loss
+
+        return jax.lax.scan(
+            body, (params, states, upd_state, iteration),
+            ((xs, ys, ms, lms), rngs),
+        )
+
+    return worker
+
+
 class ParameterAveragingTrainer:
     """Reference-exact parameter averaging over mesh 'workers'.
 
@@ -310,25 +392,14 @@ class ParameterAveragingTrainer:
         BatchNormalizationParamInitializer) are pmean'd; recurrent stream
         states are NOT (workers are rebuilt from broadcast each split —
         worker RNN state never crosses the averaging boundary)."""
-        net = self.net
         save_updater = self.save_updater
+        scan = local_round_scan(self.net, loss_call, update_call)
 
         def worker(params, states, upd_state, xs, ys, ms, lms, iteration,
                    rngs):
             # xs: [freq, local_b, ...] leaves — this worker's minibatches
-            def body(carry, inp):
-                params, st, upd_state, it = carry
-                (x, y, m, lm), r = inp
-                (loss, new_states), grads = jax.value_and_grad(
-                    lambda p: loss_call(p, st, x, y, r, m, lm), has_aux=True
-                )(params)
-                updates, upd_state2 = update_call(grads, upd_state, params, it)
-                params = apply_updates(params, updates, net.conf.minimize)
-                return (params, new_states, upd_state2, it + 1), loss
-
-            (params, out_states, upd_state, _), losses = jax.lax.scan(
-                body, (params, states, upd_state, iteration),
-                ((xs, ys, ms, lms), rngs),
+            (params, out_states, upd_state, _), losses = scan(
+                params, states, upd_state, xs, ys, ms, lms, iteration, rngs,
             )
             # averaging round: params (and updater state) pmean'd over workers
             params = jax.lax.pmean(params, DATA_AXIS)
@@ -377,10 +448,10 @@ class ParameterAveragingTrainer:
             ]
 
         sharded, repl = P(None, DATA_AXIS), P()
+        loss_call, update_call, _ = container_calls(net)
         return self._build_worker(
-            loss_call=lambda p, st, x, y, r, m, lm: net._loss(
-                p, st, x, y, train=True, rng=r, mask=m, label_mask=lm),
-            update_call=net.updater.update,
+            loss_call=loss_call,
+            update_call=update_call,
             combine_states=combine,
             m_spec=sharded if has_mask else repl,
             lm_spec=sharded if has_label_mask else repl,
@@ -404,34 +475,20 @@ class ParameterAveragingTrainer:
             }
 
         sharded, repl = P(None, DATA_AXIS), P()  # prefix spec: every leaf
+        loss_call, update_call, _ = container_calls(net)
         return self._build_worker(
-            loss_call=lambda p, st, x, y, r, m, lm: net._loss(
-                p, st, x, y, train=True, rng=r, masks=m or None,
-                label_masks=lm),
-            update_call=net._update_all,
+            loss_call=loss_call,
+            update_call=update_call,
             combine_states=combine,
             m_spec=sharded,
             lm_spec=sharded if has_label_masks else repl,
         )
 
     def _to_rounds(self, a):
-        """[freq*gb, ...] -> [freq, gb, ...] minibatch stacking."""
-        if a is None:
-            return None
-        a = jnp.asarray(a)
-        if a.ndim >= 2 and a.shape[0] != self.averaging_frequency:
-            gb = a.shape[0] // self.averaging_frequency
-            a = a[: gb * self.averaging_frequency].reshape(
-                (self.averaging_frequency, gb) + a.shape[1:]
-            )
-        return a
+        return stack_rounds(a, self.averaging_frequency)
 
     def _step_rngs(self):
-        net = self.net
-        return jax.vmap(lambda i: rng_mod.step_key(net._rng, i))(
-            jnp.arange(net.iteration,
-                       net.iteration + self.averaging_frequency)
-        )
+        return round_step_rngs(self.net, self.averaging_frequency)
 
     def _fit_graph(self, features, labels, masks=None,
                    label_masks=None) -> float:

@@ -123,28 +123,47 @@ def process_info() -> dict:
     }
 
 
-def local_batch_slice(global_batch: int) -> slice:
+def local_batch_slice(global_batch: int,
+                      process_count: Optional[int] = None,
+                      process_index: Optional[int] = None) -> slice:
     """Each process feeds only its shard of the global batch
     (jax.make_array_from_process_local_data pattern): process i gets the
     i-th contiguous slice.
 
-    Raises (consistently on EVERY process) when the global batch does not
-    split evenly: an uneven split would make the divisibility check in
-    ParallelWrapper pass on some processes and fail on others, turning a
-    clean ValueError into a distributed deadlock — the surviving
-    processes would block forever in the first collective waiting for the
-    dead peer."""
-    import jax
+    Raises a LOUD ValueError (consistently on EVERY process) when the
+    global batch does not split evenly across the live processes —
+    silently truncating the tail would drop examples, and an uneven
+    split would make the divisibility check in ParallelWrapper pass on
+    some processes and fail on others, turning a clean ValueError into a
+    distributed deadlock (the surviving processes would block forever in
+    the first collective waiting for the dead peer). This same rule
+    gates the elastic fleet's round partitioning
+    (parallel/fleet.ElasticParameterAveragingTrainer), which is why the
+    LIVE membership can be passed explicitly: ``process_count`` /
+    ``process_index`` override the jax.distributed topology (and, being
+    env-free and jax-free, never initialize a backend — the dead-tunnel
+    rule), so a coordinator re-forming rounds over a survivor set applies
+    the identical divisibility contract."""
+    if process_count is None:
+        import jax
 
+        process_count = jax.process_count()
+        process_index = jax.process_index()
+    elif process_index is None:
+        raise ValueError("process_index is required with process_count")
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} outside [0, {process_count})")
     from deeplearning4j_tpu.parallel.training_master import balanced_splits
 
-    pc = jax.process_count()
-    if global_batch % pc != 0:
+    if global_batch % process_count != 0:
         raise ValueError(
-            f"global batch {global_batch} not divisible by {pc} processes"
-            " — pad or trim so every process feeds an equal shard (static"
-            " shapes keep the step compiled once)")
-    return balanced_splits(global_batch, pc)[jax.process_index()]
+            f"global batch {global_batch} not divisible by "
+            f"{process_count} live processes — pad or trim so every "
+            "process feeds an equal shard; a silent tail truncation "
+            "would drop examples (static shapes keep the step compiled "
+            "once)")
+    return balanced_splits(global_batch, process_count)[process_index]
 
 
 def put_batch(array, sharding):

@@ -54,17 +54,37 @@ class Job:
 
 
 class StateTracker:
-    """In-process job queue + heartbeats + reclaim
-    (BaseHazelCastStateTracker.java:49 capability surface)."""
+    """In-process job queue + heartbeats + reclaim + fleet membership
+    (BaseHazelCastStateTracker.java:49 capability surface, promoted to the
+    elastic fleet's membership authority — ISSUE 6: `register_worker` /
+    `deregister_worker` / `live_workers` and a `membership_epoch` that
+    bumps on every join, announced departure, and heartbeat-expiry death,
+    so averaging rounds can re-form over the survivor set).
 
-    def __init__(self, heartbeat_timeout: float = 5.0):
+    Delivery guarantees for the fleet's no-drop/no-double-count contract:
+      * a reclaimed job is RE-QUEUED, never lost (no batch dropped);
+      * `complete_job` is FENCED by the assignment's attempt number — a
+        zombie executor (stalled heartbeat, job reclaimed and re-assigned
+        underneath it) gets its late completion rejected, so a split is
+        counted exactly once (`stale_completions` audits the rejections);
+      * a job failing `max_attempts` times routes to a dead-letter list
+        (`poisoned_jobs`) instead of cycling forever.
+    """
+
+    def __init__(self, heartbeat_timeout: float = 5.0,
+                 max_attempts: Optional[int] = None):
         self.heartbeat_timeout = heartbeat_timeout
+        self.max_attempts = max_attempts
         self._lock = threading.Lock()
         self._pending: List[Job] = []
         self._assigned: Dict[str, Job] = {}  # job_id -> job
         self._done: Dict[str, Job] = {}
+        self._poisoned: Dict[str, Job] = {}  # dead-letter list
         self._heartbeats: Dict[str, float] = {}
+        self._registered: List[str] = []  # fleet members, join order
+        self._epoch = 0
         self._params: Dict[str, Any] = {}  # replicated-map role
+        self.stale_completions = 0  # fenced-out zombie completions
 
     # -- job lifecycle ----------------------------------------------------
     def add_job(self, job: Job) -> None:
@@ -72,7 +92,14 @@ class StateTracker:
             self._pending.append(job)
 
     def request_job(self, worker_id: str) -> Optional[Job]:
-        """Worker asks for work (GiveMeMyJob protocol message)."""
+        """Worker asks for work (GiveMeMyJob protocol message). Returns a
+        SNAPSHOT of the job, not the tracked object: the delivered
+        attempt number must stay frozen in the worker's hands (the fence
+        token), exactly as it does over the wire transport — a shared
+        mutable Job would let a later re-assignment retroactively update
+        a zombie's attempt and slip past the completion fence."""
+        import copy
+
         with self._lock:
             self._heartbeats[worker_id] = time.monotonic()
             if not self._pending:
@@ -81,24 +108,119 @@ class StateTracker:
             job.worker_id = worker_id
             job.attempts += 1
             self._assigned[job.job_id] = job
-            return job
+            return copy.copy(job)
 
-    def complete_job(self, job_id: str, result: Any = None) -> None:
+    def complete_job(self, job_id: str, result: Any = None,
+                     attempt: Optional[int] = None) -> bool:
+        """Record a finished job. When `attempt` is given, the completion
+        is FENCED: it is accepted only while the job is still assigned at
+        that attempt number. A worker whose job was reclaimed (stalled
+        heartbeat) and re-assigned holds a stale attempt — its late
+        completion is rejected so the split cannot be double-counted.
+        Returns whether the completion was accepted."""
         with self._lock:
-            job = self._assigned.pop(job_id, None)
-            if job is None:
-                return
+            job = self._assigned.get(job_id)
+            if job is None or (attempt is not None
+                               and job.attempts != attempt):
+                if attempt is not None:
+                    # audit FENCED rejections only: an unfenced legacy
+                    # duplicate-complete is not a zombie event and must
+                    # not pollute the double-count telemetry
+                    self.stale_completions += 1
+                return False
+            del self._assigned[job_id]
             job.done = True
             job.result = result
             self._done[job_id] = job
+            return True
 
-    def fail_job(self, job_id: str) -> None:
-        """JobFailed message: back to the queue."""
+    def fail_job(self, job_id: str, attempt: Optional[int] = None) -> bool:
+        """JobFailed message: back to the queue — unless the job has
+        already burned `max_attempts` deliveries, in which case it routes
+        to the dead-letter list (a poison job must not cycle forever).
+        FENCED like complete_job when `attempt` is given: a zombie whose
+        job was reclaimed and re-assigned must not yank the survivor's
+        live assignment back to pending (a third execution that burns
+        attempts toward the poison cap). Returns True when re-queued,
+        False when fenced/poisoned/unknown."""
         with self._lock:
-            job = self._assigned.pop(job_id, None)
-            if job is not None:
-                job.worker_id = None
-                self._pending.append(job)
+            job = self._assigned.get(job_id)
+            if job is None or (attempt is not None
+                               and job.attempts != attempt):
+                return False
+            del self._assigned[job_id]
+            return self._requeue_or_poison_locked(job)
+
+    def _requeue_or_poison_locked(self, job: Job) -> bool:
+        """Shared by every re-queue path (JobFailed, heartbeat-expiry
+        reclaim, announced departure): a job that already burned
+        `max_attempts` deliveries routes to the dead-letter list — a
+        split whose executor keeps DYING (not just raising) must hit the
+        same cap as one that keeps failing, or it cycles until the round
+        timeout instead of surfacing in poisoned_jobs()."""
+        if (self.max_attempts is not None
+                and job.attempts >= self.max_attempts):
+            self._poisoned[job.job_id] = job
+            return False
+        job.worker_id = None
+        self._pending.append(job)
+        return True
+
+    def poisoned_jobs(self) -> Dict[str, int]:
+        """Dead-letter list: job_id -> attempts burned before giving up."""
+        with self._lock:
+            return {k: j.attempts for k, j in self._poisoned.items()}
+
+    def stale_completion_count(self) -> int:
+        """Fenced-out zombie completions (RPC-safe accessor: the fleet's
+        telemetry must see the counter through the wire transport too)."""
+        with self._lock:
+            return self.stale_completions
+
+    # -- fleet membership --------------------------------------------------
+    def register_worker(self, worker_id: str) -> int:
+        """Worker joins the fleet; returns the new membership epoch."""
+        with self._lock:
+            self._heartbeats[worker_id] = time.monotonic()
+            if worker_id not in self._registered:
+                self._registered.append(worker_id)
+                self._epoch += 1
+            return self._epoch
+
+    def deregister_worker(self, worker_id: str) -> int:
+        """Announced departure (the SIGTERM'd worker's goodbye): drop the
+        member, RE-QUEUE its in-flight jobs immediately (no heartbeat
+        expiry to wait out), bump the epoch."""
+        with self._lock:
+            self._heartbeats.pop(worker_id, None)
+            if worker_id in self._registered:
+                self._registered.remove(worker_id)
+                self._epoch += 1
+            for job_id in list(self._assigned):
+                job = self._assigned[job_id]
+                if job.worker_id == worker_id:
+                    del self._assigned[job_id]
+                    self._requeue_or_poison_locked(job)
+            return self._epoch
+
+    def live_workers(self) -> List[str]:
+        """Registered members with a fresh heartbeat, in join order."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                w for w in self._registered
+                if now - self._heartbeats.get(w, 0.0)
+                <= self.heartbeat_timeout
+            ]
+
+    def membership(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"epoch": self._epoch, "workers": list(self._registered)}
+
+    @property
+    def membership_epoch(self) -> int:
+        with self._lock:
+            return self._epoch
 
     # -- heartbeats / failure detection -----------------------------------
     def heartbeat(self, worker_id: str) -> None:
@@ -115,7 +237,9 @@ class StateTracker:
 
     def reclaim_dead_jobs(self) -> int:
         """Re-queue jobs assigned to workers that stopped heartbeating
-        (the ClearWorker/job-reclaim protocol)."""
+        (the ClearWorker/job-reclaim protocol). Dead workers are also
+        DEREGISTERED from the fleet membership (epoch bump), so the next
+        averaging round re-forms over the survivor set."""
         dead = set(self.dead_workers())
         reclaimed = 0
         with self._lock:
@@ -123,11 +247,13 @@ class StateTracker:
                 job = self._assigned[job_id]
                 if job.worker_id in dead:
                     del self._assigned[job_id]
-                    job.worker_id = None
-                    self._pending.append(job)
+                    self._requeue_or_poison_locked(job)
                     reclaimed += 1
             for w in dead:
                 self._heartbeats.pop(w, None)
+                if w in self._registered:
+                    self._registered.remove(w)
+                    self._epoch += 1
         return reclaimed
 
     # -- shared parameter storage (replicated-map role) --------------------
@@ -231,6 +357,9 @@ _RPC_METHODS = frozenset({
     "request_job", "complete_job", "fail_job", "heartbeat", "add_job",
     "dead_workers", "reclaim_dead_jobs", "set_params", "get_params",
     "counts", "results", "drain_results",
+    # fleet membership + dead-letter surface (ISSUE 6)
+    "register_worker", "deregister_worker", "live_workers", "membership",
+    "poisoned_jobs", "stale_completion_count",
 })
 
 
@@ -373,14 +502,33 @@ class RemoteStateTracker:
         return Job(d["job_id"], d["payload"], worker_id=worker_id,
                    attempts=d["attempts"])
 
-    def complete_job(self, job_id: str, result: Any = None) -> None:
-        self._call("complete_job", job_id, result)
+    def complete_job(self, job_id: str, result: Any = None,
+                     attempt: Optional[int] = None) -> bool:
+        return self._call("complete_job", job_id, result, attempt)
 
-    def fail_job(self, job_id: str) -> None:
-        self._call("fail_job", job_id)
+    def fail_job(self, job_id: str, attempt: Optional[int] = None) -> bool:
+        return self._call("fail_job", job_id, attempt)
 
     def heartbeat(self, worker_id: str) -> None:
         self._call("heartbeat", worker_id)
+
+    def register_worker(self, worker_id: str) -> int:
+        return self._call("register_worker", worker_id)
+
+    def deregister_worker(self, worker_id: str) -> int:
+        return self._call("deregister_worker", worker_id)
+
+    def live_workers(self) -> List[str]:
+        return self._call("live_workers")
+
+    def membership(self) -> Dict[str, Any]:
+        return self._call("membership")
+
+    def poisoned_jobs(self) -> Dict[str, int]:
+        return self._call("poisoned_jobs")
+
+    def stale_completion_count(self) -> int:
+        return self._call("stale_completion_count")
 
     def dead_workers(self) -> List[str]:
         return self._call("dead_workers")

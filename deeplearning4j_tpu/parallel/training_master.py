@@ -342,6 +342,75 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.execute_training(net, load_exported_datasets(path))
 
 
+class ElasticParameterAveragingTrainingMaster(ParameterAveragingTrainingMaster):
+    """The averaging master over the ELASTIC fleet (ISSUE 6): identical
+    split/average control plane, but each split executes through
+    parallel/fleet.ElasticParameterAveragingTrainer — workers join and
+    leave mid-run (every round re-forms over the live membership, the
+    split count tracking the survivor set), a dead member's in-flight
+    work is reclaimed, and SIGTERM'd OS-process members announce
+    departure. ``num_workers`` here sizes the SPLITS (examples per round
+    = workers x batch x freq, reference :148) and the initial in-process
+    fleet; the live round fan-out is the membership's business.
+
+    Pick ``batch_size_per_worker * averaging_frequency * num_workers``
+    divisible by every membership size the run may shrink/grow through —
+    an indivisible round raises loudly (multihost.local_batch_slice
+    rule) instead of silently truncating the tail."""
+
+    def __init__(self, *args, fleet_kwargs: Optional[dict] = None, **kw):
+        super().__init__(*args, **kw)
+        self.fleet_kwargs = dict(fleet_kwargs or {})
+
+    def execute_training(self, net, iterator) -> None:
+        from deeplearning4j_tpu.parallel.fleet import (
+            ElasticParameterAveragingTrainer,
+        )
+
+        if self._trainer is None or self._trainer_net is not net:
+            if self._trainer is not None:
+                # the old fleet's worker threads must not outlive the
+                # trainer swap (they would keep polling the old tracker
+                # on the shared core forever)
+                self._trainer.close()
+            self._trainer = ElasticParameterAveragingTrainer(
+                net,
+                num_workers=self.num_workers,
+                averaging_frequency=self.averaging_frequency,
+                save_updater=self.save_updater,
+                **self.fleet_kwargs,
+            )
+            self._trainer_net = net
+        # the split/retry/stats loop is inherited verbatim: the parent
+        # only drives self._trainer through .fit(x, y), a contract the
+        # fleet trainer implements, and it rebuilds the trainer only when
+        # _trainer_net is not net — which we just pinned
+        super().execute_training(net, iterator)
+
+    @property
+    def fleet(self):
+        """The live ElasticParameterAveragingTrainer (None before the
+        first execute_training) — membership surface for admit/evict."""
+        return self._trainer
+
+    def close(self) -> None:
+        """Stop the fleet this master spawned (worker threads + any
+        tracker server) — the master owns the trainer lifecycle, so the
+        caller that used it like the base master must not be left with
+        daemon threads polling the job queue forever."""
+        if self._trainer is not None:
+            self._trainer.close()
+            self._trainer = None
+            self._trainer_net = None
+
+    def __enter__(self) -> "ElasticParameterAveragingTrainingMaster":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+
 class DistributedEvaluator:
     """Map-reduce evaluation (EvaluateFlatMapFunction +
     EvaluationReduceFunction): evaluate shards independently, merge."""

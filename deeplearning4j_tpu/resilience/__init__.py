@@ -11,6 +11,9 @@ tests drive — never ambient).
 from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     ChaosConfig,
     ChaosMonkey,
+    CoordinatorPartitioned,
+    FleetChaos,
+    FleetChaosConfig,
     InjectedKill,
     TransientDeviceError,
 )

@@ -138,6 +138,117 @@ class ChaosMonkey:
             bitflip_file(target, offset=c.get("at_byte"))
 
 
+# ---------------------------------------------------------------------------
+# Fleet faults (ISSUE 6): deterministic failures for the elastic fleet
+# runtime (parallel/fleet.py) — worker loss mid-round, stalled heartbeats
+# (the zombie-executor double-count hazard), and a partitioned coordinator.
+# ---------------------------------------------------------------------------
+
+
+class CoordinatorPartitioned(ConnectionError):
+    """A chaos-injected membership-plane partition: the coordinator's
+    poll of the membership authority fails (the Hazelcast split-brain /
+    ZooKeeper session-loss failure the reference inherits from its
+    cluster substrate)."""
+
+
+@dataclass
+class FleetChaosConfig:
+    """Declarative fleet fault plan. Rounds are 1-based averaging rounds;
+    faults key on the ROUND (and, where executor identity is racy, on the
+    SPLIT — whichever worker holds that split is the victim, which keeps
+    the fault deterministic under free-for-all job scheduling while the
+    round's numerics stay executor-independent by construction).
+
+      kill_worker       — {"worker": id, "in_round": r}: the worker dies
+                          at its first job poll of round r (holding its
+                          job, if it got one) — heartbeat expiry detects
+                          it, its split is reclaimed, the NEXT round
+                          re-forms over the survivors.
+      kill_split        — {"round": r, "split": s}: whoever takes split s
+                          of round r dies HOLDING it (guaranteed reclaim
+                          + re-execution path).
+      stall_heartbeat   — {"round": r, "split": s, "sleep_s": x}: the
+                          holder of split s goes silent for x seconds
+                          (> the heartbeat timeout) while still alive —
+                          the job is reclaimed and re-executed; the
+                          zombie's late completion must be FENCED out
+                          (StateTracker attempt fencing), after which the
+                          zombie re-registers (rejoin).
+      partition_coordinator — {"at_round": r, "polls": k}: the first k
+                          membership polls of round r raise
+                          :class:`CoordinatorPartitioned`; the
+                          coordinator must retry / fall back to the
+                          last-known membership instead of dying.
+    """
+
+    kill_worker: Optional[dict] = None
+    kill_split: Optional[dict] = None
+    stall_heartbeat: Optional[dict] = None
+    partition_coordinator: Optional[dict] = None
+
+
+class FleetChaos:
+    """Stateful executor of a :class:`FleetChaosConfig`, consulted by the
+    fleet coordinator (membership polls) and its workers (job polls /
+    job receipt). Deterministic: the same config against the same round
+    sequence injects the same faults exactly once each."""
+
+    def __init__(self, config: FleetChaosConfig):
+        if isinstance(config, dict):
+            config = FleetChaosConfig(**config)
+        self.config = config
+        c = config.partition_coordinator or {}
+        self._partition_polls_left = int(c.get("polls", 0))
+        self._killed_worker = False
+        self._killed_split = False
+        self._stalled = False
+        self.log: list = []  # (round, fault) audit trail for tests
+
+    def kill_on_poll(self, worker_id: str, rnd: int) -> bool:
+        """Worker-side, at each job poll: True -> the worker dies now."""
+        c = self.config.kill_worker
+        if (c is not None and not self._killed_worker
+                and worker_id == c["worker"] and rnd >= int(c["in_round"])):
+            self._killed_worker = True
+            self.log.append((rnd, f"kill_worker:{worker_id}"))
+            return True
+        return False
+
+    def kill_on_job(self, worker_id: str, rnd: int, split: int) -> bool:
+        """Worker-side, after TAKING a job: True -> die holding it."""
+        c = self.config.kill_split
+        if (c is not None and not self._killed_split
+                and rnd == int(c["round"]) and split == int(c["split"])):
+            self._killed_split = True
+            self.log.append((rnd, f"kill_split:{split}:{worker_id}"))
+            return True
+        return False
+
+    def stall_on_job(self, worker_id: str, rnd: int,
+                     split: int) -> Optional[float]:
+        """Worker-side, after taking a job: seconds to go silent for
+        (heartbeats suppressed by the silence itself), or None."""
+        c = self.config.stall_heartbeat
+        if (c is not None and not self._stalled
+                and rnd == int(c["round"]) and split == int(c["split"])):
+            self._stalled = True
+            self.log.append((rnd, f"stall_heartbeat:{split}:{worker_id}"))
+            return float(c.get("sleep_s", 1.0))
+        return None
+
+    def on_membership_poll(self, rnd: int) -> None:
+        """Coordinator-side, before each membership poll."""
+        c = self.config.partition_coordinator
+        if (c is not None and rnd == int(c.get("at_round", -1))
+                and self._partition_polls_left > 0):
+            self._partition_polls_left -= 1
+            self.log.append((rnd, "partition"))
+            raise CoordinatorPartitioned(
+                f"injected membership-plane partition at round {rnd} "
+                f"({self._partition_polls_left} polls left)")
+
+
 def truncate_file(path: str, keep: int = 16) -> None:
     """Write-then-truncate fault: keep only the first `keep` bytes (a
     crash mid-write that an atomic rename would normally prevent —

@@ -68,6 +68,9 @@ class ResilientTrainer:
         preempt_signals=(signal.SIGTERM,),
         max_step_retries: int = 0,
         retry_backoff_s: float = 0.05,
+        retry_backoff_max_s: float = 2.0,
+        retry_jitter: float = 0.25,
+        retry_salt: Optional[int] = None,
     ):
         self.trainee = trainee
         # parallel trainers carry the state-owning container on .net
@@ -80,11 +83,38 @@ class ResilientTrainer:
         self.preempt_signals = tuple(preempt_signals)
         self.max_step_retries = int(max_step_retries)
         self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_max_s = float(retry_backoff_max_s)
+        self.retry_jitter = float(retry_jitter)
+        # per-process salt: N peers hitting the same fault at the same
+        # step must NOT sleep identical jittered backoffs (they would
+        # re-collide on every attempt — the multihost env contract gives
+        # a stable per-process value without touching jax; pid covers
+        # the unconfigured case). Overridable for reproducible tests.
+        if retry_salt is None:
+            import os
+
+            from deeplearning4j_tpu.parallel.multihost import _int_env, \
+                PROCESS_ID_ENV
+
+            pid = _int_env(PROCESS_ID_ENV)
+            retry_salt = pid if pid is not None else os.getpid()
+        self.retry_salt = int(retry_salt)
         self._preempt_requested = False
         self._old_handlers = {}
         self.losses: List[float] = []
         self.resumed_step: Optional[int] = None  # set when a restore ran
         self.step = 0  # completed batches (trainer steps), incl. restored
+        # fault-plane telemetry beside dispatch_stats/memory_stats: a
+        # fleet trainee (parallel/fleet.py) already carries the dict
+        # (reclaims/membership counters) — share it rather than shadow it
+        self.resilience_stats = getattr(trainee, "resilience_stats", None)
+        if self.resilience_stats is None:
+            self.resilience_stats = {}
+        for key, zero in (("retries", 0), ("reclaims", 0),
+                          ("backoff_seconds", 0.0), ("preemptions", 0),
+                          ("resumes", 0)):
+            self.resilience_stats.setdefault(key, zero)
+        self.net.resilience_stats = self.resilience_stats
 
     # ---------------------------------------------------------------- signals
     def _install_handlers(self) -> None:
@@ -125,6 +155,7 @@ class ResilientTrainer:
             if restored is not None:
                 self.step = int(restored["step"])
                 self.resumed_step = self.step
+                self.resilience_stats["resumes"] += 1
                 start_epoch = int(restored["epoch"])
                 pending_iter_state = restored.get("iterator_state")
                 logger.info(
@@ -179,6 +210,22 @@ class ResilientTrainer:
         return net
 
     # ----------------------------------------------------------------- steps
+    def _retry_backoff(self, attempts: int) -> float:
+        """Exponential backoff with a cap and DETERMINISTIC jitter:
+        uncapped doubling can sleep past the preemption budget, and
+        jitterless retries from N workers re-collide on every attempt
+        (thundering herd). The jitter fraction derives from (step,
+        attempt, per-process salt) via a Weyl-style integer mix — no RNG
+        state, so the bit-exact resume contract is untouched (sleep
+        never enters the numerics), while peers hitting the same fault
+        at the same step still sleep DIFFERENT amounts (the salt is what
+        actually decorrelates the herd)."""
+        base = min(self.retry_backoff_max_s,
+                   self.retry_backoff_s * (2 ** (attempts - 1)))
+        mix = ((self.step + 1) * 2654435761 + attempts * 40503
+               + (self.retry_salt + 1) * 83492791) % (2 ** 32)
+        return base * (1.0 + self.retry_jitter * (mix / 2.0 ** 32))
+
     def _step_with_retry(self, ds) -> float:
         attempts = 0
         while True:
@@ -190,7 +237,9 @@ class ResilientTrainer:
                 attempts += 1
                 if attempts > self.max_step_retries:
                     raise
-                backoff = self.retry_backoff_s * (2 ** (attempts - 1))
+                backoff = self._retry_backoff(attempts)
+                self.resilience_stats["retries"] += 1
+                self.resilience_stats["backoff_seconds"] += backoff
                 logger.warning(
                     "transient device error at step %d (attempt %d/%d): "
                     "%s — retrying in %.2fs", self.step + 1, attempts,
@@ -211,6 +260,7 @@ class ResilientTrainer:
     def _check_preempt(self, epoch: int, iterator) -> None:
         if not self._preempt_requested:
             return
+        self.resilience_stats["preemptions"] += 1
         path = None
         if self.manager is not None:
             path = self.manager.save(

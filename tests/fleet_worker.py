@@ -1,0 +1,36 @@
+"""OS-process fleet member for the elastic-fleet tests (test_fleet.py).
+
+One worker of the cross-process fleet: control plane over the
+coordinator's StateTrackerServer TCP transport (RemoteStateTracker),
+data plane over the spool directory (split / round-state / result npz
+files) — the reference's worker JVM role (ExecuteWorkerFlatMap over the
+Hazelcast member plane). SIGTERM makes it checkpoint nothing and
+announce departure (the coordinator owns the authoritative checkpoint);
+the parent test asserts the fleet rebalances and the run stays bit-exact.
+
+Usage: fleet_worker.py <host:port> <worker_id> <spool_dir> [idle_exit_s]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)  # match the pytest parent env
+
+from deeplearning4j_tpu.parallel.fleet import run_worker  # noqa: E402
+
+
+def main() -> None:
+    address, worker_id, spool = sys.argv[1], sys.argv[2], sys.argv[3]
+    idle = float(sys.argv[4]) if len(sys.argv) > 4 else None
+    print(f"FLEET_WORKER_UP {worker_id}", flush=True)
+    run_worker(address, worker_id, spool, stop_after_idle_s=idle)
+    print(f"FLEET_WORKER_DONE {worker_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

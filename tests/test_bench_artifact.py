@@ -190,3 +190,17 @@ def test_input_pipeline_leg_registered():
     assert "input_pipeline" in expected_legs()
     m = _load_bench()
     assert "input_pipeline" in m._CPU_ONLY_LEGS
+
+
+def test_elastic_dp_leg_registered():
+    """ISSUE 6: the elastic_dp leg (averaging-round overhead of the
+    elastic fleet at N workers, with/without one lost worker) is in the
+    expected set AND in bench.py's CPU-only set — the fleet control
+    plane is host-side work, so its proof must run (and persist) even
+    with the tunnel dead."""
+    from scripts.bench_state import EXPECTED, expected_legs
+
+    assert "elastic_dp" in EXPECTED
+    assert "elastic_dp" in expected_legs()
+    m = _load_bench()
+    assert "elastic_dp" in m._CPU_ONLY_LEGS

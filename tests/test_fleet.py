@@ -1,0 +1,507 @@
+"""Elastic fleet runtime (parallel/fleet.py — ISSUE 6).
+
+The headline contract extends PR 3's resilience bar across MEMBERSHIP
+changes: a run that loses worker k at round s (chaos kill, detected by
+heartbeat expiry, in-flight split reclaimed and re-executed) and
+re-admits a replacement at round s+m produces BIT-exact params and loss
+curve versus a deterministic replay of the same membership schedule
+(scripted evict/admit at the same round boundaries), and matches the
+serial big-batch run to 1e-5 (the
+TestCompareParameterAveragingSparkVsSingleMachine.java:115-262 bar).
+Plus: fenced completions under stalled heartbeats (no split
+double-counted), partitioned-coordinator retry/fallback, poisoned-split
+loudness, the file membership transport, checkpoint/restore through
+ResilientTrainer with the coordinator owning the single authoritative
+checkpoint, and the cross-process (OS-process worker) path including
+corrupt-checkpoint fallback under fleet restore.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.fleet import (
+    ElasticParameterAveragingTrainer,
+    FileMembershipBoard,
+    shard_for,
+)
+from deeplearning4j_tpu.resilience import (
+    ChaosConfig,
+    ChaosMonkey,
+    CheckpointManager,
+    FleetChaos,
+    FleetChaosConfig,
+    InjectedKill,
+    ResilientTrainer,
+)
+from deeplearning4j_tpu.resilience import chaos as chaos_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# deterministic shared data; 12 examples/round divides by 1..4 workers
+_RNG = np.random.default_rng(0)
+ROUNDS, GB = 6, 12
+X = _RNG.standard_normal((ROUNDS * GB, 4)).astype(np.float32)
+Y = np.eye(3, dtype=np.float32)[_RNG.integers(0, 3, ROUNDS * GB)]
+
+
+def build_mln() -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+        .list()
+        .layer(0, DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf)
+
+
+def round_batch(r: int):
+    return X[r * GB:(r + 1) * GB], Y[r * GB:(r + 1) * GB]
+
+
+def serial_run(rounds=ROUNDS):
+    net = build_mln()
+    for r in range(rounds):
+        net.fit(*round_batch(r))
+    return net
+
+
+def params_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+def max_dev(a, b) -> float:
+    return max(
+        float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+# ------------------------------------------------------------- equivalence
+class TestElasticEquivalence:
+    def test_static_fleet_matches_serial(self):
+        """Sanity floor: no membership change — freq-1 SGD averaging over
+        3 workers == serial big-batch (host-side averaging variant of the
+        shard_map trainer's contract)."""
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=3, averaging_frequency=1,
+            heartbeat_s=1.0)
+        try:
+            for r in range(ROUNDS):
+                fleet.fit(*round_batch(r))
+        finally:
+            fleet.close()
+        assert max_dev(fleet.net.params, serial_run().params) < 1e-5
+        assert fleet.resilience_stats["rounds"] == ROUNDS
+        assert fleet.resilience_stats["reclaims"] == 0
+
+    def test_worker_loss_and_rejoin_bit_exact_vs_replay_and_serial(self):
+        """HEADLINE: lose a worker mid-round 2 (dies HOLDING its split —
+        reclaimed, re-executed by a survivor), re-admit a replacement
+        before round 4. Bit-exact vs the scripted replay of the same
+        membership schedule; == serial to 1e-5."""
+        chaos = FleetChaos(FleetChaosConfig(
+            kill_split={"round": 2, "split": 1}))
+        f1 = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=3, averaging_frequency=1,
+            heartbeat_s=1.0, chaos=chaos)
+        l1 = []
+        try:
+            for r in range(ROUNDS):
+                if r == 3:
+                    f1.admit_worker("replacement")
+                l1.append(float(f1.fit(*round_batch(r))))
+        finally:
+            f1.close()
+        assert f1.resilience_stats["reclaims"] == 1
+        assert chaos.log and chaos.log[0][0] == 2
+
+        # deterministic replay: same membership schedule, no faults —
+        # evict at the round-2 boundary, admit before round 4
+        f2 = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=3, averaging_frequency=1,
+            heartbeat_s=1.0)
+        l2 = []
+        try:
+            for r in range(ROUNDS):
+                if r == 2:
+                    f2.evict_worker("w1")
+                if r == 3:
+                    f2.admit_worker("replacement")
+                l2.append(float(f2.fit(*round_batch(r))))
+        finally:
+            f2.close()
+        assert l1 == l2, "loss curve diverged from the membership replay"
+        assert params_equal(f1.net.params, f2.net.params)
+        assert params_equal(f1.net.updater_state, f2.net.updater_state)
+        assert max_dev(f1.net.params, serial_run().params) < 1e-5
+        # membership really changed: 3 -> 2 -> 3 workers
+        assert f1.epoch >= 3
+
+    def test_worker_join_reforms_rounds(self):
+        """Fleet GROWS mid-run: rounds re-form over the enlarged set and
+        the run still matches serial (split count is membership-driven,
+        numerics membership-schedule-deterministic)."""
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=1.0)
+        try:
+            for r in range(ROUNDS):
+                if r == 2:
+                    fleet.admit_worker()
+                    fleet.admit_worker()
+                fleet.fit(*round_batch(r))
+        finally:
+            fleet.close()
+        assert max_dev(fleet.net.params, serial_run().params) < 1e-5
+        assert fleet.epoch >= 2
+
+    def test_uneven_split_raises_loud(self):
+        """Satellite: a round that does not divide across the live
+        membership fails LOUDLY instead of silently truncating the tail
+        (the multihost.local_batch_slice rule)."""
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=3, averaging_frequency=1,
+            heartbeat_s=1.0)
+        try:
+            with pytest.raises(ValueError, match="not divisible by 3 live"):
+                fleet.fit(X[:10], Y[:10])
+        finally:
+            fleet.close()
+
+    def test_elastic_training_master(self):
+        """ElasticParameterAveragingTrainingMaster: the Spark-style
+        master's split/average loop over the fleet trainer == the base
+        (shard_map) master on the same data/seed to 1e-5."""
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.parallel.training_master import (
+            ElasticParameterAveragingTrainingMaster,
+            ParameterAveragingTrainingMaster,
+        )
+
+        mk_it = lambda: ListDataSetIterator(X[:48], Y[:48], batch=12)
+        base_net, elastic_net = build_mln(), build_mln()
+        ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size_per_worker=6, averaging_frequency=1,
+        ).execute_training(base_net, mk_it())
+        with ElasticParameterAveragingTrainingMaster(
+                num_workers=2, batch_size_per_worker=6,
+                averaging_frequency=1,
+                fleet_kwargs={"heartbeat_s": 1.0}) as master:
+            master.execute_training(elastic_net, mk_it())
+        assert master.fleet is None  # close() owned the fleet lifecycle
+        assert max_dev(base_net.params, elastic_net.params) < 1e-5
+
+    def test_admit_after_evict_gets_fresh_id(self):
+        """Generated member ids never collide with a live member after
+        an eviction (a collision would orphan the old thread and make
+        the admit a membership no-op)."""
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=3, averaging_frequency=1,
+            heartbeat_s=1.0)
+        try:
+            fleet.fit(*round_batch(0))
+            fleet.evict_worker("w0")
+            wid = fleet.admit_worker()
+            assert wid not in ("w1", "w2")
+            deadline = time.time() + 5
+            while len(fleet.tracker.live_workers()) < 3:
+                assert time.time() < deadline
+                time.sleep(0.01)
+            with pytest.raises(ValueError, match="already a live member"):
+                fleet.admit_worker("w1")
+        finally:
+            fleet.close()
+
+
+# ------------------------------------------------------------ fleet faults
+class TestFleetFaults:
+    def test_stalled_heartbeat_fenced_no_double_count(self):
+        """A zombie (alive, heartbeat stalled past the timeout) loses its
+        split to reclaim; its LATE completion is fenced out by the
+        attempt number (counted, never applied), it re-registers, and the
+        round's numerics equal the fault-free run — no split dropped, no
+        split double-counted."""
+        chaos = FleetChaos(FleetChaosConfig(
+            stall_heartbeat={"round": 1, "split": 0, "sleep_s": 2.5}))
+        f1 = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=0.4, chaos=chaos)
+        try:
+            for r in range(2):
+                f1.fit(*round_batch(r))
+            # the zombie wakes AFTER its round completed: wait for its
+            # late completion to hit the fence before asserting
+            deadline = time.monotonic() + 10.0
+            while (f1.tracker.stale_completions < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+        finally:
+            f1.close()
+        assert f1.resilience_stats["reclaims"] >= 1
+        assert f1.tracker.stale_completions >= 1
+        # replay of the detected schedule: the zombie was deregistered at
+        # reclaim, so round 2 formed over ONE worker — script the same
+        f2 = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=0.4)
+        try:
+            f2.fit(*round_batch(0))
+            f2.evict_worker("w1")
+            f2.fit(*round_batch(1))
+        finally:
+            f2.close()
+        assert params_equal(f1.net.params, f2.net.params), \
+            "zombie completion leaked into the average"
+
+    def test_partitioned_coordinator_retries(self):
+        """Membership-plane partition (CoordinatorPartitioned on the
+        first polls of round 2): the coordinator retries with backoff and
+        the run completes bit-identical to the unpartitioned one."""
+        chaos = FleetChaos(FleetChaosConfig(
+            partition_coordinator={"at_round": 2, "polls": 3}))
+        f1 = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=1.0, chaos=chaos)
+        try:
+            for r in range(3):
+                f1.fit(*round_batch(r))
+        finally:
+            f1.close()
+        assert f1.resilience_stats["membership_retries"] == 3
+        f2 = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=1.0)
+        try:
+            for r in range(3):
+                f2.fit(*round_batch(r))
+        finally:
+            f2.close()
+        assert params_equal(f1.net.params, f2.net.params)
+
+    def test_poisoned_split_is_loud(self, monkeypatch):
+        """A split that fails every attempt routes to the dead-letter
+        list and the round raises — a batch may not silently vanish."""
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=1.0, job_max_attempts=2, round_timeout_s=30.0)
+        monkeypatch.setattr(
+            fleet, "_execute_split",
+            lambda payload: (_ for _ in ()).throw(RuntimeError("boom")))
+        try:
+            with pytest.raises(RuntimeError, match="poisoned"):
+                fleet.fit(*round_batch(0))
+        finally:
+            fleet.close()
+
+
+# ----------------------------------------------------- membership transports
+class TestMembershipTransports:
+    def test_file_membership_board(self, tmp_path):
+        board = FileMembershipBoard(str(tmp_path), heartbeat_timeout=0.2)
+        board.register_worker("a")
+        board.register_worker("b")
+        assert sorted(board.live_workers()) == ["a", "b"]
+        board.deregister_worker("a")  # announced departure
+        assert board.live_workers() == ["b"]
+        time.sleep(0.3)  # b's heartbeat goes stale
+        assert board.live_workers() == []
+        board.heartbeat("b")
+        assert board.live_workers() == ["b"]
+
+    def test_fleet_over_file_board(self, tmp_path):
+        """The file transport as the fleet's membership authority: rounds
+        form over the board's live set, == serial."""
+        board = FileMembershipBoard(str(tmp_path), heartbeat_timeout=1.0)
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=1.0, membership_board=board)
+        try:
+            for r in range(2):
+                fleet.fit(*round_batch(r))
+        finally:
+            fleet.close()
+        assert max_dev(fleet.net.params, serial_run(2).params) < 1e-5
+
+    def test_board_outage_reads_as_partition_not_empty_fleet(self,
+                                                             tmp_path,
+                                                             monkeypatch):
+        """A shared-mount blip must surface as ConnectionError (the
+        coordinator's retry/fallback path), never as an empty live set
+        that runs the round-timeout clock out."""
+        board = FileMembershipBoard(str(tmp_path), heartbeat_timeout=1.0)
+        board.register_worker("a")
+        monkeypatch.setattr(os, "listdir",
+                            lambda p: (_ for _ in ()).throw(OSError("nfs")))
+        with pytest.raises(ConnectionError, match="membership board"):
+            board.live_workers()
+
+    def test_shard_for(self):
+        assert shard_for("b", ["c", "a", "b"]) == (1, 3)
+        assert shard_for("gone", ["a"]) is None
+
+    def test_membership_listener_reshards_pipeline(self):
+        """Live ETL resharding hook: on a membership change the attached
+        pipeline is re-partitioned to this member's (rank, count) at the
+        agreed boundary."""
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+        from deeplearning4j_tpu.etl.pipeline import InputPipeline
+
+        pipe = InputPipeline(ListDataSetIterator(X[:32], Y[:32], 4),
+                             workers=1, device_put=False, shard=(0, 3))
+        fleet = ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=3, averaging_frequency=1,
+            heartbeat_s=1.0)
+        fleet.attach_pipeline(pipe, "w0", boundary_fn=lambda: 100)
+        from deeplearning4j_tpu.etl.pipeline import DROP_SHARD
+
+        gone = InputPipeline(ListDataSetIterator(X[:32], Y[:32], 4),
+                             workers=1, device_put=False, shard=(2, 3))
+        fleet.attach_pipeline(gone, "w2", boundary_fn=lambda: 100)
+        try:
+            fleet.fit(*round_batch(0))  # first membership note: 3 workers
+            fleet.evict_worker("w2")
+            fleet.fit(*round_batch(1))  # re-forms over 2, reshards at 100
+        finally:
+            fleet.close()
+        sched = pipe._shard_schedule_snapshot()
+        assert sched[-1] == [100, [0, 2]], sched
+        # the DEPARTED member's pipeline owns NOTHING from the boundary
+        # (None would mean "everything" and double-feed the survivors)
+        assert gone._shard_schedule_snapshot()[-1] == [100, DROP_SHARD]
+
+
+# -------------------------------------------------- resilience integration
+class TestFleetResilience:
+    def test_fleet_kill_resume_bit_exact(self, tmp_path):
+        """PR 3's crash-recovery bar over the ELASTIC trainer: the
+        coordinator is killed at round 3 (chaos), a fresh coordinator +
+        fleet restores the authoritative checkpoint and finishes —
+        params and loss curve bit-identical to uninterrupted."""
+        from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+        mk_it = lambda: ListDataSetIterator(X, Y, batch=GB)
+        mk_fleet = lambda chaos=None: ElasticParameterAveragingTrainer(
+            build_mln(), num_workers=2, averaging_frequency=1,
+            heartbeat_s=1.0)
+
+        baseline = ResilientTrainer(mk_fleet())
+        baseline.fit(mk_it(), num_epochs=1)
+        baseline.trainee.close()
+
+        mgr = CheckpointManager(str(tmp_path), every_steps=2, keep_last=2)
+        killed_fleet = mk_fleet()
+        killed = ResilientTrainer(
+            killed_fleet, mgr,
+            chaos=ChaosMonkey(ChaosConfig(kill_at_step=3)))
+        with pytest.raises(InjectedKill):
+            killed.fit(mk_it(), num_epochs=1)
+        mgr.close()
+        killed_fleet.close()
+
+        mgr2 = CheckpointManager(str(tmp_path), every_steps=2, keep_last=2)
+        resumed_fleet = mk_fleet()
+        resumed = ResilientTrainer(resumed_fleet, mgr2)
+        resumed.fit(mk_it(), num_epochs=1)
+        mgr2.close()
+        resumed_fleet.close()
+
+        assert resumed.resumed_step == 2
+        stitched = killed.losses[:2] + resumed.losses
+        assert stitched == baseline.losses
+        assert params_equal(baseline.net.params, resumed.net.params)
+        # the shared fault-plane ledger: fleet counters + trainer counters
+        # in ONE dict on the net (beside dispatch_stats)
+        assert resumed.net.resilience_stats["resumes"] == 1
+        assert resumed.net.resilience_stats["rounds"] == 4
+
+    def _spawn_worker(self, addr, wid, spool):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+        return subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "fleet_worker.py"),
+             addr, wid, spool],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+    def _await_member(self, fleet, wid, proc, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while wid not in fleet.tracker.live_workers():
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"worker died: {proc.stderr.read()[-800:]}")
+            assert time.monotonic() < deadline, "worker never registered"
+            time.sleep(0.05)
+
+    def test_corrupt_checkpoint_fleet_restore_multiprocess(self, tmp_path):
+        """Satellite: verify-then-trust under FLEET restore on the
+        multi-process path. A coordinator driving a real OS-process
+        worker checkpoints per round; the latest checkpoint is
+        chaos-truncated; the restoring coordinator falls back to the
+        prior VERIFIED checkpoint and the fleet resumes — final params
+        bit-identical to the uninterrupted multi-process run."""
+        rounds = 4
+
+        def run(tag, kill_at=None, resume=False):
+            spool = str(tmp_path / f"spool-{tag}")
+            ck = str(tmp_path / "ckpt")
+            fleet = ElasticParameterAveragingTrainer(
+                build_mln(), num_workers=0, averaging_frequency=1,
+                heartbeat_s=2.0, min_workers=1, spool_dir=spool)
+            addr = fleet.serve()
+            proc = self._spawn_worker(addr, "ext0", spool)
+            try:
+                self._await_member(fleet, "ext0", proc)
+                mgr = CheckpointManager(
+                    ck, every_steps=1, keep_last=3,
+                    async_save=False) if (kill_at or resume) else None
+                chaos = (ChaosMonkey(ChaosConfig(kill_at_step=kill_at))
+                         if kill_at else None)
+                trainer = ResilientTrainer(fleet, mgr, chaos=chaos,
+                                           resume=resume)
+                from deeplearning4j_tpu.datasets.iterator import (
+                    ListDataSetIterator,
+                )
+
+                it = ListDataSetIterator(X[:rounds * GB], Y[:rounds * GB],
+                                         batch=GB)
+                if kill_at:
+                    with pytest.raises(InjectedKill):
+                        trainer.fit(it, num_epochs=1)
+                else:
+                    trainer.fit(it, num_epochs=1)
+                if mgr:
+                    mgr.close()
+                return trainer
+            finally:
+                fleet.close()
+                proc.terminate()
+                proc.wait(timeout=30)
+
+        baseline = run("base")
+        killed = run("killed", kill_at=3)
+        # chaos-truncate the LATEST checkpoint (step 3): restore must
+        # fall back to the prior verified one (step 2), not load garbage
+        mgr_probe = CheckpointManager(str(tmp_path / "ckpt"))
+        (_, newest) = mgr_probe.checkpoints()[-1]
+        chaos_mod.truncate_file(os.path.join(newest, "model.zip"), keep=12)
+        resumed = run("resumed", resume=True)
+        assert resumed.resumed_step == 2  # fell back past the corrupt 3
+        stitched = killed.losses[:2] + resumed.losses
+        assert stitched == baseline.losses
+        assert params_equal(baseline.net.params, resumed.net.params)

@@ -278,6 +278,88 @@ class TestSharding:
             InputPipeline(ListDataSetIterator(X, Y, 16), shard=(2, 2))
 
 
+class TestLiveResharding:
+    """ISSUE 6: the elastic fleet re-partitions the multihost shard
+    selection on a membership epoch bump — at an agreed absolute batch
+    boundary, with no batch dropped or double-owned across the fleet's
+    pipelines, and with the delivered-batch cursor semantics intact."""
+
+    def mk(self, shard):
+        return InputPipeline(ListDataSetIterator(X, Y, 16), workers=1,
+                             device_put=False, shard=shard)
+
+    def test_reshard_covers_every_batch_exactly_once(self):
+        from deeplearning4j_tpu.etl.pipeline import DROP_SHARD
+
+        ref = [ds_bytes(d) for d in ListDataSetIterator(X, Y, 16)]
+        # membership {A,B} for seqs 0..2; B leaves at seq 3 -> A owns all
+        pa, pb = self.mk((0, 2)), self.mk((1, 2))
+        pa.reshard((0, 1), at_seq=3)
+        pb.reshard(DROP_SHARD, at_seq=3)
+        got_a = [ds_bytes(d) for d in pa]
+        got_b = [ds_bytes(d) for d in pb]
+        assert got_b == [ref[1]]  # old partition below the boundary
+        assert got_a == [ref[0], ref[2]] + ref[3:]
+        assert sorted(got_a + got_b) == sorted(ref)
+
+    def test_reshard_boundary_already_passed_raises(self):
+        pipe = self.mk((0, 2))
+        it = iter(pipe)
+        next(it)
+        next(it)  # dispatcher has decided ownership past seq 0 by now
+        with pytest.raises(ValueError, match="already passed"):
+            pipe.reshard((0, 1), at_seq=0)
+        it.close()
+
+    def test_resume_replays_reshard_schedule(self):
+        """The shard schedule rides the delivered-batch cursor: a
+        kill/resume mid-schedule re-owns exactly the same batches."""
+        pipe = self.mk((0, 2))
+        pipe.reshard((0, 1), at_seq=3)
+        full = [ds_bytes(d) for d in pipe]
+        pipe2 = self.mk((0, 2))
+        pipe2.reshard((0, 1), at_seq=3)
+        it = iter(pipe2)
+        first = [ds_bytes(next(it))]
+        st = pipe2.state()
+        assert st["shard_schedule"] == [[0, [0, 2]], [3, [0, 1]]]
+        it.close()
+        fresh = self.mk((0, 2))  # schedule comes from the cursor
+        fresh.restore_state(st)
+        rest = [ds_bytes(d) for d in fresh]
+        assert first + rest == full
+
+    def test_deferred_reshard_applies_next_pass(self):
+        ref = [ds_bytes(d) for d in ListDataSetIterator(X, Y, 16)]
+        pipe = self.mk((0, 2))
+        assert [ds_bytes(d) for d in pipe] == ref[0::2]
+        pipe.reshard((1, 2))  # at_seq=None: from the next pass
+        assert [ds_bytes(d) for d in pipe] == ref[1::2]
+
+    def test_deferred_reshard_survives_checkpoint_resume(self):
+        """A deferred (next-pass) reshard scheduled before a checkpoint
+        must ride the cursor: the restored pipeline applies it exactly
+        like the survivor that never died."""
+        ref = [ds_bytes(d) for d in ListDataSetIterator(X, Y, 16)]
+        pipe = self.mk((0, 2))
+        assert [ds_bytes(d) for d in pipe] == ref[0::2]
+        pipe.reshard((1, 2))  # deferred; then the process is killed
+        st = pipe.state()
+        assert st["pending_shard"] == [1, 2]
+        fresh = self.mk((0, 2))
+        fresh.restore_state(st)
+        fresh.reset()  # resume landed at an epoch boundary: fresh pass
+        assert [ds_bytes(d) for d in fresh] == ref[1::2]
+
+    def test_consumed_boundary_does_not_refire_next_pass(self):
+        ref = [ds_bytes(d) for d in ListDataSetIterator(X, Y, 16)]
+        pipe = self.mk((0, 2))
+        pipe.reshard((0, 1), at_seq=3)
+        list(pipe)  # consumes the boundary
+        # next pass: the FINAL shard owns from seq 0 (no mid-pass flip)
+        assert [ds_bytes(d) for d in pipe] == ref
+
+
 class TestResume:
     def test_wrap_mode_resume_exact(self):
         ref = list(ListDataSetIterator(X, Y, 16))

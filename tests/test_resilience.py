@@ -360,6 +360,46 @@ def test_transient_error_retry_with_backoff(tmp_path):
     assert params_equal(baseline.net.params, retried.net.params)
 
 
+def test_retry_backoff_capped_and_jittered():
+    """Satellite (ISSUE 6): exponential backoff saturates at
+    retry_backoff_max_s, carries a bounded deterministic jitter, and the
+    retries/backoff-seconds land in resilience_stats."""
+    trainer = ResilientTrainer(build_mln(), max_step_retries=8,
+                               retry_backoff_s=0.5, retry_backoff_max_s=2.0,
+                               retry_jitter=0.25)
+    vals = [trainer._retry_backoff(a) for a in range(1, 9)]
+    for a, v in enumerate(vals, start=1):
+        base = min(2.0, 0.5 * 2 ** (a - 1))
+        assert base <= v <= base * 1.25, (a, v)
+    assert max(vals) <= 2.0 * 1.25  # the cap holds at high attempt counts
+    # deterministic: same (step, attempt) -> same jitter, different
+    # attempts -> decorrelated sleeps (the thundering-herd fix)
+    assert vals == [trainer._retry_backoff(a) for a in range(1, 9)]
+    assert len({round(v / min(2.0, 0.5 * 2 ** (a - 1)), 6)
+                for a, v in enumerate(vals, start=1)}) > 1
+
+
+def test_resilience_stats_counts_retries_and_rides_listener_chain():
+    """resilience_stats sits on the net beside dispatch_stats, counts
+    retries + accumulated backoff, and ResilienceStatsListener surfaces
+    it through the listener chain."""
+    from deeplearning4j_tpu.optimize.listeners import ResilienceStatsListener
+
+    chaos = ChaosMonkey(ChaosConfig(transient_error_at_step=3,
+                                    transient_error_count=2))
+    trainer = ResilientTrainer(build_mln(), chaos=chaos,
+                               max_step_retries=2, retry_backoff_s=0.01)
+    listener = ResilienceStatsListener(frequency=1)
+    trainer.net.set_listeners(listener)
+    trainer.fit(mk_iterator(), num_epochs=1)
+    stats = trainer.net.resilience_stats
+    assert stats is trainer.resilience_stats
+    assert stats["retries"] == 2
+    assert stats["backoff_seconds"] > 0
+    assert listener.snapshots, "listener never saw resilience_stats"
+    assert listener.snapshots[-1]["retries"] == 2
+
+
 def test_transient_error_exhausts_retries():
     chaos = ChaosMonkey(ChaosConfig(transient_error_at_step=2,
                                     transient_error_count=5))

@@ -54,6 +54,95 @@ class TestStateTracker:
         t.set_params("model", [1.0, 2.0])
         assert t.get_params("model") == [1.0, 2.0]
 
+    def test_poison_job_routed_to_dead_letter(self):
+        """Satellite (ISSUE 6): fail_job stops re-queueing after
+        max_attempts — the poison job lands in poisoned_jobs() instead
+        of cycling forever."""
+        t = StateTracker(max_attempts=2)
+        t.add_job(Job("bad", payload=1))
+        assert t.request_job("w0").attempts == 1
+        assert t.fail_job("bad") is True  # attempt 1 < cap: re-queued
+        assert t.counts()["pending"] == 1
+        assert t.request_job("w0").attempts == 2
+        assert t.fail_job("bad") is False  # cap hit: dead-letter
+        assert t.counts()["pending"] == 0
+        assert t.poisoned_jobs() == {"bad": 2}
+        assert t.request_job("w1") is None  # never redelivered
+
+    def test_reclaim_path_hits_dead_letter_cap_too(self):
+        """A split whose executor keeps DYING (reclaim path, not
+        JobFailed) must hit the same max_attempts cap — else it cycles
+        until the round timeout instead of surfacing as poisoned."""
+        t = StateTracker(heartbeat_timeout=0.03, max_attempts=2)
+        t.add_job(Job("j", payload=1))
+        for _ in range(2):  # two deliveries, two executor deaths
+            assert t.request_job("doomed") is not None
+            time.sleep(0.08)
+            t.reclaim_dead_jobs()
+        assert t.poisoned_jobs() == {"j": 2}
+        assert t.counts()["pending"] == 0
+
+    def test_unbounded_attempts_by_default(self):
+        t = StateTracker()  # max_attempts=None: legacy behavior
+        t.add_job(Job("j", payload=1))
+        for _ in range(5):
+            t.request_job("w0")
+            assert t.fail_job("j") is True
+        assert t.poisoned_jobs() == {}
+
+    def test_fenced_completion_rejects_stale_attempt(self):
+        """A zombie executor (job reclaimed + re-assigned underneath it)
+        completes with a stale attempt number: rejected and audited —
+        the no-double-count half of the fleet contract."""
+        t = StateTracker(heartbeat_timeout=0.05)
+        t.add_job(Job("j", payload=1))
+        stale = t.request_job("zombie")  # attempts=1
+        time.sleep(0.12)
+        assert t.reclaim_dead_jobs() == 1
+        fresh = t.request_job("survivor")  # attempts=2
+        assert t.complete_job("j", "late", attempt=stale.attempts) is False
+        assert t.stale_completions == 1
+        assert t.complete_job("j", "good", attempt=fresh.attempts) is True
+        assert t.results()["j"] == "good"
+
+    def test_fenced_fail_job_cannot_yank_survivor_assignment(self):
+        """A zombie's late JobFailed must not pop the survivor's live
+        re-assignment (a third execution burning attempts toward the
+        poison cap) — fail_job fences like complete_job."""
+        t = StateTracker(heartbeat_timeout=0.05, max_attempts=5)
+        t.add_job(Job("j", payload=1))
+        stale = t.request_job("zombie")
+        time.sleep(0.12)
+        t.reclaim_dead_jobs()
+        fresh = t.request_job("survivor")
+        assert t.fail_job("j", attempt=stale.attempts) is False  # fenced
+        assert t.counts()["assigned"] == 1  # survivor still holds it
+        assert t.complete_job("j", "good", attempt=fresh.attempts) is True
+        # legacy unfenced fail still works
+        t.add_job(Job("k", payload=2))
+        t.request_job("w")
+        assert t.fail_job("k") is True
+
+    def test_membership_epoch_join_leave_death(self):
+        """The promoted membership authority: epoch bumps on join,
+        announced departure (in-flight jobs re-queued immediately), and
+        heartbeat-expiry death."""
+        t = StateTracker(heartbeat_timeout=0.05)
+        assert t.register_worker("a") == 1
+        assert t.register_worker("b") == 2
+        assert t.register_worker("a") == 2  # idempotent: no bump
+        assert t.live_workers() == ["a", "b"]
+        t.add_job(Job("j", payload=1))
+        job = t.request_job("a")
+        assert job is not None
+        assert t.deregister_worker("a") == 3  # goodbye: job re-queued NOW
+        assert t.counts()["pending"] == 1
+        assert t.live_workers() == ["b"]
+        time.sleep(0.12)  # b goes silent
+        t.reclaim_dead_jobs()
+        assert t.live_workers() == []
+        assert t.membership() == {"epoch": 4, "workers": []}
+
 
 class TestRouters:
     def test_hogwild_processes_all_jobs(self):
@@ -246,6 +335,36 @@ class TestCrossProcess:
             if hang.poll() is None:
                 hang.kill()
                 hang.wait()
+
+    def test_remote_membership_and_dead_letter_surface(self, server):
+        """The fleet's membership + dead-letter protocol over the TCP
+        transport (the promoted tracker is the cross-process membership
+        authority)."""
+        from deeplearning4j_tpu.parallel.statetracker import (
+            RemoteStateTracker,
+        )
+
+        server.tracker.max_attempts = 1
+        t = RemoteStateTracker.from_address(server.address)
+        try:
+            assert t.register_worker("rw0") == 1
+            assert t.live_workers() == ["rw0"]
+            assert t.membership() == {"epoch": 1, "workers": ["rw0"]}
+            t.add_job(Job("j", {"n": 1}))
+            job = t.request_job("rw0")
+            # fenced completion over the wire: stale attempt rejected
+            assert t.complete_job("j", {"v": 1},
+                                  attempt=job.attempts + 1) is False
+            assert t.complete_job("j", {"v": 1},
+                                  attempt=job.attempts) is True
+            t.add_job(Job("poison", {"n": 2}))
+            t.request_job("rw0")
+            assert t.fail_job("poison") is False  # max_attempts=1
+            assert t.poisoned_jobs() == {"poison": 1}
+            assert t.deregister_worker("rw0") == 2
+            assert t.live_workers() == []
+        finally:
+            t.close()
 
     def test_remote_params_and_errors(self, server):
         from deeplearning4j_tpu.parallel.statetracker import (
