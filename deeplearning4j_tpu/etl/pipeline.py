@@ -58,6 +58,7 @@ import numpy as np
 
 from deeplearning4j_tpu.datasets.iterator import DataSet, DataSetIterator
 from deeplearning4j_tpu.etl.stats import PipelineStats, dataset_nbytes
+from deeplearning4j_tpu.obs import trace as obs_trace
 
 WORKERS_ENV = "DL4J_TPU_PIPELINE_WORKERS"
 PREFETCH_ENV = "DL4J_TPU_PREFETCH"
@@ -463,15 +464,20 @@ class InputPipeline(DataSetIterator):
         delivered_clean = False
         try:
             while True:
-                t0 = time.perf_counter()
-                try:
-                    item = out_q.get(timeout=0.5)
-                except queue.Empty:
+                waited = 0.0  # consumer-side wait for THIS delivery
+                while True:
+                    t0 = time.perf_counter()
+                    try:
+                        item = out_q.get(timeout=0.5)
+                    except queue.Empty:
+                        waited += time.perf_counter() - t0
+                        stats.add_consumer_stall(time.perf_counter() - t0)
+                        if coord.error is not None:
+                            raise coord.error
+                        continue
+                    waited += time.perf_counter() - t0
                     stats.add_consumer_stall(time.perf_counter() - t0)
-                    if coord.error is not None:
-                        raise coord.error
-                    continue
-                stats.add_consumer_stall(time.perf_counter() - t0)
+                    break
                 if item is _SENTINEL:
                     if coord.error is not None:
                         raise coord.error
@@ -480,6 +486,13 @@ class InputPipeline(DataSetIterator):
                 ds, cursor, nbytes, n = item
                 self._last_state = cursor
                 stats.record_delivered(nbytes, n, out_q.qsize())
+                # staging-wait span: how long the TRAINING thread starved
+                # before this batch arrived — the per-delivery view of
+                # pipeline_stats.stall_seconds (recorded after the fact so
+                # the hot loop keeps its shape; obs off = no-op)
+                obs_trace.record_span("etl.wait", waited,
+                                      seq=cursor.get("next_seq"),
+                                      bytes=nbytes, records=n)
                 yield ds
         finally:
             stop.set()
@@ -659,7 +672,10 @@ class InputPipeline(DataSetIterator):
             return ds
         import jax
 
-        put = jax.device_put
+        with obs_trace.span("etl.stage"):
+            return self._device_put(ds, jax.device_put)
+
+    def _device_put(self, ds, put):
         opt = lambda a: None if a is None else put(a)
         if hasattr(ds, "features_list"):
             from deeplearning4j_tpu.datasets.iterator import MultiDataSet

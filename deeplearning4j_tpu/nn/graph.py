@@ -103,6 +103,10 @@ class ComputationGraph:
         )
         # True while fit_iterator drives fit() — bucketing's "auto" scope
         self._bucket_scope = False
+        # ledgers join the central MetricsRegistry (see MultiLayerNetwork)
+        from deeplearning4j_tpu.obs.registry import register_net
+
+        register_net(self)
 
     # ------------------------------------------------------------------ init
     def _infer_input_shapes(self) -> Dict[str, Tuple[int, ...]]:
@@ -774,6 +778,9 @@ class ComputationGraph:
         iterator = maybe_wrap(iterator)
         if getattr(iterator, "pipeline_stats", None) is not None:
             self.pipeline_stats = iterator.pipeline_stats
+            from deeplearning4j_tpu.obs.registry import register_net
+
+            register_net(self)  # the freshly adopted ingest ledger
         fused = (fused_batches > 1
                  and self.conf.backprop_type != "truncated_bptt"
                  and self.conf.optimization_algo

@@ -91,6 +91,13 @@ class MultiLayerNetwork:
         # True while fit_iterator drives fit(): the scope where bucketing's
         # "auto" mode applies (dispatch.bucketing_mode)
         self._bucket_scope = False
+        # every *_stats ledger above joins the central MetricsRegistry
+        # (obs/registry.py) — one Prometheus scrape covers them all; the
+        # attach points for later ledgers (pipeline_stats adoption,
+        # ResilientTrainer/fleet resilience_stats) re-register
+        from deeplearning4j_tpu.obs.registry import register_net
+
+        register_net(self)
 
     # ------------------------------------------------------------------ init
     def _infer_input_shape(self) -> Tuple[int, ...]:
@@ -678,6 +685,9 @@ class MultiLayerNetwork:
         iterator = maybe_wrap(iterator)
         if getattr(iterator, "pipeline_stats", None) is not None:
             self.pipeline_stats = iterator.pipeline_stats
+            from deeplearning4j_tpu.obs.registry import register_net
+
+            register_net(self)  # the freshly adopted ingest ledger
         if self.conf.pretrain:
             self.pretrain(iterator)
             if hasattr(iterator, "reset"):

@@ -53,6 +53,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.obs import trace as obs_trace
+
 ENV_DONATE = "DL4J_TPU_DONATE"
 ENV_BUCKET = "DL4J_TPU_BUCKET_BATCHES"
 ENV_CACHE = "DL4J_TPU_COMPILE_CACHE"
@@ -223,6 +225,7 @@ def instrumented_jit(fn, name: str, stats: DispatchStats, *,
         kw["static_argnums"] = static_argnums
 
     counting = [True]  # AOT .lower() re-traces for analysis, not dispatch
+    span_name = f"dispatch.{name}"  # hoisted off the per-call hot path
 
     def traced(*args, **kwargs):
         if counting[0]:
@@ -240,13 +243,21 @@ def instrumented_jit(fn, name: str, stats: DispatchStats, *,
                 stats.copied_steps += 1
         before = stats.traces[name]
         t0 = time.perf_counter()
-        out = jfn(*args, **kwargs)
-        if stats.traces[name] > before:
-            # this call traced: its wall time is dominated by trace+XLA
-            # compile (dispatch itself returns async) — the per-trace
-            # compile-cost ledger the DispatchStatsListener and the
-            # dispatch_overhead leg surface for tunnel-window triage
-            stats.trace_seconds[name] += time.perf_counter() - t0
+        # obs span (DL4J_TPU_OBS, default off -> shared null context):
+        # HOST-side dispatch timing only — the jit returns async, so the
+        # span never adds a device sync (the listener-chain bulk-readback
+        # rule). Attrs distinguish trace vs compiled-cache-hit dispatch.
+        with obs_trace.span(span_name, donated=bool(donated),
+                            step=step) as sp:
+            out = jfn(*args, **kwargs)
+            if stats.traces[name] > before:
+                # this call traced: its wall time is dominated by
+                # trace+XLA compile (dispatch itself returns async) — the
+                # per-trace compile-cost ledger the DispatchStatsListener
+                # and the dispatch_overhead leg surface for tunnel-window
+                # triage
+                stats.trace_seconds[name] += time.perf_counter() - t0
+                sp.set_attr("traced", True)
         return out
 
     def lower(*args, **kwargs):

@@ -44,65 +44,108 @@ class CollectScoresIterationListener(IterationListener):
             self.scores.append((iteration, float(score)))
 
 
-class DispatchStatsListener(IterationListener):
-    """Surface the dispatch-efficiency telemetry (ops/dispatch.DispatchStats
-    — XLA traces, compiled-cache hits, donated-vs-copied steps, bucketing
-    pad counts) through the listener chain every N iterations, the same hook
-    the reference uses for its per-iteration observability
-    (StochasticGradientDescent.java:66-67). A burst of `traces` growth
-    mid-training is the retrace pathology this PR's bucketing exists to
-    kill; this listener is how it becomes visible without a profiler."""
+class StatsListener(IterationListener):
+    """Render ANY ``net.*_stats`` ledger through the listener chain with
+    ONE uniform format (ISSUE 7 dedup: DispatchStatsListener and
+    ResilienceStatsListener used to each hand-roll their own log line;
+    every future ledger would have grown a third copy). The ledgers are
+    registry views (obs/registry.MetricsRegistry adopts the same
+    objects), so this listener is the log-line rendering of the same
+    snapshot the Prometheus scrape flattens.
 
-    def __init__(self, frequency: int = 100):
+    ``attr`` names the ledger attribute on the model (class attribute on
+    subclasses); a ledger is anything with ``snapshot()`` or a plain
+    dict. Every N iterations the snapshot is appended to ``snapshots``
+    (with ``iteration`` riding along — the stored shape both old
+    listeners already exposed) and logged as sorted ``key=value`` pairs:
+    floats to 3 decimals, dict-valued entries collapsed to the sum of
+    their numeric leaves (`traces={'train_step': 1}` renders as
+    ``traces=1`` — per-jit detail stays in ``snapshots``/the registry).
+    """
+
+    attr: str = ""
+    title: str = ""
+
+    def __init__(self, frequency: int = 100, attr: str = "",
+                 title: str = ""):
         self.frequency = max(1, int(frequency))
+        if attr:
+            self.attr = attr
+        if title:
+            self.title = title
+        elif not self.title:
+            self.title = (self.attr[:-len("_stats")]
+                          if self.attr.endswith("_stats") else self.attr)
         self.snapshots: List[dict] = []
 
-    def iteration_done(self, model, iteration, score):
-        stats = getattr(model, "dispatch_stats", None)
-        if stats is None or iteration % self.frequency != 0:
-            return
-        snap = dict(stats.snapshot(), iteration=iteration)
-        self.snapshots.append(snap)
-        logger.info(
-            "iteration %d dispatch: traces=%s trace_secs=%.3f cache_hits=%d "
-            "donated=%d copied=%d padded_batches=%d fused_fallbacks=%d",
-            iteration, dict(snap["traces"]),
-            sum(snap["trace_seconds"].values()),
-            sum(snap["cache_hits"].values()),
-            snap["donated_steps"], snap["copied_steps"],
-            snap["padded_batches"], snap["fused_fallbacks"],
-        )
+    @staticmethod
+    def _snapshot(stats) -> dict:
+        return stats.snapshot() if hasattr(stats, "snapshot") else dict(stats)
 
+    @staticmethod
+    def _render_value(v):
+        if isinstance(v, dict):
+            total = 0.0
+            for leaf in v.values():
+                if isinstance(leaf, dict):
+                    leaf = sum(x for x in leaf.values()
+                               if isinstance(x, (int, float)))
+                if isinstance(leaf, (int, float)):
+                    total += leaf
+            v = total
+        if isinstance(v, float):
+            return f"{v:.3f}"
+        return str(v)
 
-class ResilienceStatsListener(IterationListener):
-    """Surface the fault-plane telemetry (``net.resilience_stats`` —
-    transient-step retries + accumulated backoff, fleet split reclaims,
-    membership epoch/retries, preemptions/resumes; written by
-    resilience/trainer.ResilientTrainer and
-    parallel/fleet.ElasticParameterAveragingTrainer) through the listener
-    chain every N iterations, beside DispatchStatsListener — worker loss
-    and retry storms become visible in the same place score and retraces
-    already are (the reference's Spark training-stats role,
-    dl4j-spark/.../stats/StatsUtils.java:65)."""
-
-    def __init__(self, frequency: int = 100):
-        self.frequency = max(1, int(frequency))
-        self.snapshots: List[dict] = []
+    def render(self, snap: dict) -> str:
+        return " ".join(
+            f"{k}={self._render_value(v)}"
+            for k, v in sorted(snap.items())
+            if k != "iteration" and isinstance(
+                v, (int, float, dict)))
 
     def iteration_done(self, model, iteration, score):
-        stats = getattr(model, "resilience_stats", None)
+        stats = getattr(model, self.attr, None)
         if stats is None or iteration % self.frequency != 0:
             return
-        snap = dict(stats, iteration=iteration)
+        snap = dict(self._snapshot(stats), iteration=iteration)
         self.snapshots.append(snap)
-        logger.info(
-            "iteration %d resilience: retries=%d backoff=%.2fs reclaims=%d "
-            "epoch=%s stale_completions=%s preemptions=%s resumes=%s",
-            iteration, snap.get("retries", 0),
-            snap.get("backoff_seconds", 0.0), snap.get("reclaims", 0),
-            snap.get("epoch", "-"), snap.get("stale_completions", "-"),
-            snap.get("preemptions", "-"), snap.get("resumes", "-"),
-        )
+        logger.info("iteration %d %s: %s", iteration, self.title,
+                    self.render(snap))
+
+
+class DispatchStatsListener(StatsListener):
+    """The dispatch-efficiency ledger (ops/dispatch.DispatchStats — XLA
+    traces, compiled-cache hits, donated-vs-copied steps, bucketing pad
+    counts) on the listener chain, the same hook the reference uses for
+    per-iteration observability (StochasticGradientDescent.java:66-67).
+    A burst of `traces` growth mid-training is the retrace pathology
+    bucketing exists to kill; this is how it becomes visible without a
+    profiler."""
+
+    attr = "dispatch_stats"
+
+
+class ResilienceStatsListener(StatsListener):
+    """The fault-plane ledger (``net.resilience_stats`` — transient-step
+    retries + backoff, fleet split reclaims, membership epoch, last
+    checkpoint step, preemptions/resumes; written by
+    resilience/trainer.ResilientTrainer and parallel/fleet
+    .ElasticParameterAveragingTrainer) on the listener chain beside
+    DispatchStatsListener — worker loss and retry storms surface where
+    score and retraces already do (the reference's Spark training-stats
+    role, dl4j-spark/.../stats/StatsUtils.java:65)."""
+
+    attr = "resilience_stats"
+
+
+class PipelineStatsListener(StatsListener):
+    """The ingest ledger (etl/stats.PipelineStats — staged batches,
+    consumer-vs-producer stall split, throughput rates) on the same
+    chain; `stall_fraction` > 0 here is the input pipeline starving the
+    accelerator."""
+
+    attr = "pipeline_stats"
 
 
 class PerformanceListener(IterationListener):

@@ -71,6 +71,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.obs import journal as obs_journal
+from deeplearning4j_tpu.obs import registry as obs_registry
+from deeplearning4j_tpu.obs import trace as obs_trace
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 HEARTBEAT_ENV = "DL4J_TPU_FLEET_HEARTBEAT_S"
@@ -401,6 +405,13 @@ def run_worker(address: str, worker_id: str, spool_dir: str, *,
         RemoteStateTracker,
     )
 
+    # per-worker flight-recorder path (unless the operator pinned one):
+    # N workers sharing the coordinator's cwd must not last-writer-wins
+    # clobber the coordinator's checkpoint/membership/preempt timeline
+    os.environ.setdefault(
+        "DL4J_TPU_OBS_JOURNAL",
+        os.path.join(spool_dir, f".obs_journal.{worker_id}.jsonl"))
+
     manifest = FileServiceRegistry(spool_dir).retrieve(_MANIFEST)
     if manifest is None:
         raise RuntimeError(f"no fleet manifest under {spool_dir!r}")
@@ -573,6 +584,9 @@ class ElasticParameterAveragingTrainer:
             "epoch": 0, "stale_completions": 0,
         }
         net.resilience_stats = self.resilience_stats
+        # join the central MetricsRegistry: the fleet's rounds/epoch/
+        # reclaim counters become one more view beside dispatch/memory
+        obs_registry.register_net(net)
         self._workers: Dict[str, _InProcessWorker] = {}
         self._pending_spawn = [f"w{i}" for i in range(int(num_workers))]
         self._worker_seq = int(num_workers)  # next generated member id
@@ -746,6 +760,9 @@ class ElasticParameterAveragingTrainer:
             return
         self._epoch += 1
         self.resilience_stats["epoch"] = self._epoch
+        obs_journal.event("membership", epoch=self._epoch,
+                          live=list(live), was=self._last_live,
+                          round=self.round_index)
         logger.info("fleet membership epoch %d: %s (was %s) — rounds "
                     "re-form over %d workers", self._epoch, live,
                     self._last_live, len(live))
@@ -800,10 +817,13 @@ class ElasticParameterAveragingTrainer:
                 f"{None if rs is None else rs['round']} is current")
         import jax.numpy as jnp
 
-        xs, ys, ms, lms = rs["splits"][payload["split"]]
-        (params, states, upd, _), losses = self._local_step()(
-            rs["params"], rs["states"], rs["upd"], xs, ys, ms, lms,
-            jnp.asarray(rs["iteration"], jnp.int32), rs["rngs"])
+        with obs_trace.span("fleet.split", round=int(payload["round"]),
+                            split=int(payload["split"]),
+                            membership_epoch=self._epoch):
+            xs, ys, ms, lms = rs["splits"][payload["split"]]
+            (params, states, upd, _), losses = self._local_step()(
+                rs["params"], rs["states"], rs["upd"], xs, ys, ms, lms,
+                jnp.asarray(rs["iteration"], jnp.int32), rs["rngs"])
         return {"split": int(payload["split"]),
                 "arrays": (params, states, upd, np.asarray(losses))}
 
@@ -869,7 +889,19 @@ class ElasticParameterAveragingTrainer:
 
     def fit(self, features, labels, mask=None, label_mask=None) -> float:
         """One elastic averaging round: re-form over the live membership,
-        split, dispatch, reclaim as needed, average in split order."""
+        split, dispatch, reclaim as needed, average in split order. The
+        round span carries the membership epoch so a flight-recorder
+        timeline correlates rounds with chaos-injected kills and the
+        elastic_dp bench leg (ISSUE 7)."""
+        with obs_trace.span("fleet.round") as sp:
+            loss = self._fit_round(features, labels, mask, label_mask)
+            sp.set_attr("round", self.round_index)
+            sp.set_attr("membership_epoch", self._epoch)
+            sp.set_attr("workers", len(self._last_live or ()))
+        return loss
+
+    def _fit_round(self, features, labels, mask=None,
+                   label_mask=None) -> float:
         net = self.net
         if net.params is None:
             net.init()

@@ -185,7 +185,12 @@ class StateTracker:
             if worker_id not in self._registered:
                 self._registered.append(worker_id)
                 self._epoch += 1
-            return self._epoch
+            epoch = self._epoch
+        from deeplearning4j_tpu.obs import journal as obs_journal
+
+        obs_journal.event("fleet.worker", action="register",
+                          worker=worker_id, epoch=epoch)
+        return epoch
 
     def deregister_worker(self, worker_id: str) -> int:
         """Announced departure (the SIGTERM'd worker's goodbye): drop the
@@ -201,7 +206,12 @@ class StateTracker:
                 if job.worker_id == worker_id:
                     del self._assigned[job_id]
                     self._requeue_or_poison_locked(job)
-            return self._epoch
+            epoch = self._epoch
+        from deeplearning4j_tpu.obs import journal as obs_journal
+
+        obs_journal.event("fleet.worker", action="deregister",
+                          worker=worker_id, epoch=epoch)
+        return epoch
 
     def live_workers(self) -> List[str]:
         """Registered members with a fresh heartbeat, in join order."""

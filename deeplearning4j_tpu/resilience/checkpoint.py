@@ -52,6 +52,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.obs import journal as obs_journal
+from deeplearning4j_tpu.obs import trace as obs_trace
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 ENV_EVERY = "DL4J_TPU_CKPT_EVERY"
@@ -234,16 +237,20 @@ class CheckpointManager:
         })
         from deeplearning4j_tpu.utils.serialization import ModelSerializer
 
-        job = _SaveJob(
-            step=int(step),
-            model_class=type(net).__name__,
-            conf_json=net.conf.to_json(),
-            params=_host_tree(net.params),
-            states=_host_tree(net.states),
-            updater_state=_host_tree(net.updater_state),
-            meta=ModelSerializer._container_meta(net),
-            training_state=training_state,
-        )
+        # the synchronous half of an async save — the only stall the
+        # train loop pays; the span makes that stall visible next to the
+        # dispatch spans it interleaves with
+        with obs_trace.span("ckpt.snapshot", step=int(step)):
+            job = _SaveJob(
+                step=int(step),
+                model_class=type(net).__name__,
+                conf_json=net.conf.to_json(),
+                params=_host_tree(net.params),
+                states=_host_tree(net.states),
+                updater_state=_host_tree(net.updater_state),
+                meta=ModelSerializer._container_meta(net),
+                training_state=training_state,
+            )
         self._last_save_t = time.monotonic()
         if block:
             self._write(job)
@@ -320,9 +327,11 @@ class CheckpointManager:
             if os.path.isdir(tmp):
                 shutil.rmtree(tmp)
             os.makedirs(tmp)
-            files = (self._write_zip_payload(tmp, job)
-                     if self.backend == "zip"
-                     else self._write_sharded_payload(tmp, job))
+            with obs_trace.span("ckpt.write", step=job.step,
+                                backend=self.backend):
+                files = (self._write_zip_payload(tmp, job)
+                         if self.backend == "zip"
+                         else self._write_sharded_payload(tmp, job))
             manifest = {
                 "format": MANIFEST_FORMAT,
                 "backend": self.backend,
@@ -346,20 +355,28 @@ class CheckpointManager:
             # here would open a whole-tree-wide window with NO checkpoint
             # for the step; .old dirs don't parse as checkpoints, so the
             # scan never sees the intermediate state
-            old = None
-            if os.path.isdir(final):
-                old = final + ".old"
-                if os.path.isdir(old):
-                    shutil.rmtree(old)
-                os.replace(final, old)
-            os.replace(tmp, final)
-            if old is not None:
-                shutil.rmtree(old, ignore_errors=True)
-            fsync_dir(self.directory)
+            with obs_trace.span("ckpt.commit", step=job.step):
+                old = None
+                if os.path.isdir(final):
+                    old = final + ".old"
+                    if os.path.isdir(old):
+                        shutil.rmtree(old)
+                    os.replace(final, old)
+                os.replace(tmp, final)
+                if old is not None:
+                    shutil.rmtree(old, ignore_errors=True)
+                fsync_dir(self.directory)
             job.path = final
+            total_bytes = sum(f["bytes"] for f in files.values())
             self.stats["saves"] += 1
-            self.stats["bytes"] += sum(f["bytes"] for f in files.values())
+            self.stats["bytes"] += total_bytes
             self.stats["write_s"] += time.perf_counter() - t0
+            # flight-recorder marker: a post-mortem timeline can line the
+            # last committed checkpoint up against spans/preemption events
+            obs_journal.event(
+                "checkpoint", step=job.step, path=final,
+                epoch=job.training_state.get("epoch", 0),
+                bytes=total_bytes)
             if self.chaos is not None:
                 self.chaos.on_checkpoint_written(final, job.step)
             self._retain()

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import List, Optional
 
+from deeplearning4j_tpu.obs import journal as obs_journal
+from deeplearning4j_tpu.obs import registry as obs_registry
 from deeplearning4j_tpu.resilience.chaos import ChaosMonkey, TransientDeviceError
 from deeplearning4j_tpu.resilience.checkpoint import CheckpointManager
 
@@ -112,9 +114,17 @@ class ResilientTrainer:
             self.resilience_stats = {}
         for key, zero in (("retries", 0), ("reclaims", 0),
                           ("backoff_seconds", 0.0), ("preemptions", 0),
-                          ("resumes", 0)):
+                          ("resumes", 0),
+                          # checkpoint correlation (ISSUE 7): the id of
+                          # the last checkpoint this trainer saved, so a
+                          # flight-recorder timeline / elastic_dp bench
+                          # row can be joined against checkpoints on disk
+                          ("last_checkpoint_step", -1)):
             self.resilience_stats.setdefault(key, zero)
         self.net.resilience_stats = self.resilience_stats
+        # the fault-plane ledger joins the central MetricsRegistry beside
+        # the net's own dispatch/memory ledgers (obs/registry.py)
+        obs_registry.register_net(self.net)
 
     # ---------------------------------------------------------------- signals
     def _install_handlers(self) -> None:
@@ -156,8 +166,13 @@ class ResilientTrainer:
                 self.step = int(restored["step"])
                 self.resumed_step = self.step
                 self.resilience_stats["resumes"] += 1
+                self.resilience_stats["last_checkpoint_step"] = self.step
                 start_epoch = int(restored["epoch"])
                 pending_iter_state = restored.get("iterator_state")
+                obs_journal.event(
+                    "resume", step=self.step, epoch=start_epoch,
+                    path=restored["path"],
+                    membership_epoch=self.resilience_stats.get("epoch"))
                 logger.info(
                     "resumed from %s (step %d, epoch %d)",
                     restored["path"], self.step, start_epoch)
@@ -192,6 +207,8 @@ class ResilientTrainer:
                         self.manager.save(
                             net, step=self.step, epoch=epoch,
                             iterator_state=self._iter_state(iterator))
+                        self.resilience_stats["last_checkpoint_step"] = \
+                            self.step
                     if self.chaos is not None:
                         self.chaos.after_step(self.step)
                     self._check_preempt(epoch, iterator)
@@ -267,4 +284,13 @@ class ResilientTrainer:
                 self.net, step=self.step, epoch=epoch,
                 iterator_state=self._iter_state(iterator), block=True)
             self.manager.flush()
+            self.resilience_stats["last_checkpoint_step"] = self.step
+        # fsync-on-preemption: the goodbye checkpoint just committed; the
+        # flight recorder's timeline (spans, checkpoint commits, this
+        # marker) must survive the kill the same way (obs/journal.py —
+        # no-op unless DL4J_TPU_OBS is on)
+        obs_journal.event(
+            "preempt", step=self.step, epoch=epoch, path=path,
+            membership_epoch=self.resilience_stats.get("epoch"))
+        obs_journal.flush(fsync=True)
         raise Preempted(self.step, path)

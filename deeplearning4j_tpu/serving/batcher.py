@@ -40,6 +40,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs import trace as obs_trace
 from deeplearning4j_tpu.ops import dispatch
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
@@ -71,13 +72,20 @@ def _resolve(fut: Future, result=None, exception=None) -> bool:
 
 
 class _Request:
-    __slots__ = ("rows", "future", "deadline", "enqueued")
+    __slots__ = ("rows", "future", "deadline", "enqueued", "rid")
 
-    def __init__(self, rows: np.ndarray, deadline: float) -> None:
+    def __init__(self, rows: np.ndarray, deadline: float,
+                 rid: Optional[int] = None) -> None:
         self.rows = rows
         self.future: Future = Future()
         self.deadline = deadline
         self.enqueued = time.monotonic()
+        # observability request id (ISSUE 7): assigned at the engine
+        # boundary, rides the queue, and surfaces in the serve.batch
+        # span's request_ids — the thread that joins a request's span to
+        # the coalesced batch (and, via span parenting on the worker
+        # thread, to the jit dispatch underneath)
+        self.rid = rid
 
 
 class DynamicBatcher:
@@ -113,17 +121,19 @@ class DynamicBatcher:
         self._worker.start()
 
     # -- client side ------------------------------------------------------
-    def submit(self, rows, timeout_s: Optional[float] = None) -> Future:
+    def submit(self, rows, timeout_s: Optional[float] = None,
+               rid: Optional[int] = None) -> Future:
         """Enqueue ``rows`` ([k, ...] — one request may carry several rows)
         and return a Future resolving to the [k, ...] outputs. Raises
-        QueueFullError when the queue is at capacity (backpressure)."""
+        QueueFullError when the queue is at capacity (backpressure).
+        ``rid`` is the engine-assigned observability request id."""
         rows = np.asarray(rows)
         if rows.ndim < 1 or rows.shape[0] < 1:
             raise ValueError("submit() needs at least one row")
         self.stats.record_request()
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
                                        else self.default_timeout_s)
-        req = _Request(rows, deadline)
+        req = _Request(rows, deadline, rid=rid)
         with self._cond:
             if not self._running:
                 raise RuntimeError("batcher is stopped")
@@ -142,10 +152,11 @@ class DynamicBatcher:
             self._cond.notify_all()
         return req.future
 
-    def predict(self, rows, timeout_s: Optional[float] = None) -> np.ndarray:
+    def predict(self, rows, timeout_s: Optional[float] = None,
+                rid: Optional[int] = None) -> np.ndarray:
         """submit() + wait; raises RequestTimeoutError past the deadline."""
         budget = timeout_s if timeout_s is not None else self.default_timeout_s
-        fut = self.submit(rows, timeout_s=budget)
+        fut = self.submit(rows, timeout_s=budget, rid=rid)
         try:
             return fut.result(timeout=budget + self.max_wait_s)
         except RequestTimeoutError:
@@ -231,7 +242,15 @@ class DynamicBatcher:
                          else max(dispatch.bucket_size(n), n))
             self.stats.record_batch(n, padded_to)
             try:
-                out = np.asarray(self._infer(batch))
+                # the coalesced-batch span: carries every member request
+                # id, and (running on this worker thread) becomes the
+                # PARENT of the dispatch.<jit> span the model call opens
+                # — request -> batch -> jit, one joined timeline
+                with obs_trace.span(
+                        "serve.batch", rows=int(n),
+                        padded_to=int(padded_to),
+                        request_ids=[r.rid for r in taken]):
+                    out = np.asarray(self._infer(batch))
             except Exception as e:  # noqa: BLE001 — serving boundary
                 # per-request error accounting happens at the boundary
                 # that answers the client (engine handler / predict

@@ -35,6 +35,7 @@ Env knobs (read at engine construction):
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -44,6 +45,9 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs import registry as obs_registry
+from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.obs.exporter import PROMETHEUS_CONTENT_TYPE
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher,
     QueueFullError,
@@ -88,6 +92,15 @@ class ServingEngine:
             os.environ.get("DL4J_TPU_SERVE_CONTINUOUS", "").strip().lower()
             not in ("0", "off", "false", "no"))
         self.stats = ServingStats()
+        # the serving ledger joins the central MetricsRegistry (ISSUE 7):
+        # one Prometheus scrape covers serving counters AND every
+        # registered net ledger (dispatch/memory/pipeline/resilience);
+        # completed-request latencies feed a real bucket histogram there
+        _metrics = obs_registry.default_registry()
+        _metrics.register_ledger(self, "serving_stats", self.stats)
+        self.stats.on_latency = lambda s: _metrics.histogram(
+            "dl4j_serving_latency_seconds", s)
+        self._rid = itertools.count(1)  # observability request ids
         self.registry = ModelRegistry()
         self._batchers: Dict[str, DynamicBatcher] = {}
         self._decoders: Dict[str, Any] = {}
@@ -131,10 +144,16 @@ class ServingEngine:
         if rec.model is None:
             raise KeyError(f"{rec.key} is unloaded")
         x = np.asarray(x)
-        if not self.batching_enabled:
-            return self._direct_output(rec, x)
-        batcher = self._batcher_for(rec)
-        return batcher.predict(x, timeout_s=timeout_s)
+        rid = next(self._rid)
+        with obs_trace.span("serve.request", rid=rid, model=rec.key,
+                            rows=int(x.shape[0])):
+            if not self.batching_enabled:
+                return self._direct_output(rec, x)
+            batcher = self._batcher_for(rec)
+            # rid threads THROUGH the batcher: the serve.batch span on
+            # the worker thread lists it, joining this request's span to
+            # the coalesced dispatch it rode in
+            return batcher.predict(x, timeout_s=timeout_s, rid=rid)
 
     def generate(self, tokens: np.ndarray, n_new: int, *,
                  temperature: float = 1.0, seed: int = 0,
@@ -280,8 +299,27 @@ class ServingEngine:
                         "models": [r["name"] + "@v" + str(r["version"])
                                    for r in engine.registry.describe()],
                     })
-                elif self.path == "/metrics":
-                    self._send(200, engine.metrics())
+                elif self.path.split("?")[0] == "/metrics":
+                    # content negotiation: a Prometheus scraper (Accept:
+                    # text/plain / openmetrics, or an explicit
+                    # ?format=prometheus) gets text exposition of the
+                    # CENTRAL registry — serving counters plus every
+                    # registered net ledger in one scrape; everything
+                    # else keeps the original JSON contract
+                    accept = self.headers.get("Accept", "")
+                    if ("format=prometheus" in self.path
+                            or "text/plain" in accept
+                            or "openmetrics" in accept):
+                        body = (obs_registry.default_registry()
+                                .render_prometheus().encode())
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         PROMETHEUS_CONTENT_TYPE)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._send(200, engine.metrics())
                 elif self.path == "/models":
                     self._send(200, {
                         "models": engine.registry.describe(),

@@ -35,6 +35,12 @@ class ServingStats:
     def __init__(self, window: int = 2048) -> None:
         self._lock = threading.Lock()
         self._lat = deque(maxlen=int(window))
+        # optional bucket-histogram sink (obs/registry.py): the engine
+        # wires completed-request latencies into the central
+        # MetricsRegistry so the Prometheus scrape gets real cumulative
+        # buckets, not just the ring percentiles. Called OUTSIDE the
+        # lock (the registry has its own).
+        self.on_latency = None
         self.requests = 0          # submitted to the engine
         self.completed = 0         # answered successfully
         self.errors = 0            # model/payload errors
@@ -58,6 +64,9 @@ class ServingStats:
         with self._lock:
             self.completed += 1
             self._lat.append(float(seconds))
+        hook = self.on_latency
+        if hook is not None:
+            hook(float(seconds))
 
     def record_error(self) -> None:
         with self._lock:

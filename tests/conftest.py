@@ -76,6 +76,10 @@ _QUICK_FILES = {
     # bit-exactness + == serial contracts (~15s on tiny nets); the
     # OS-process-worker leg is excluded below (full tier covers it)
     "test_fleet.py",
+    # observability plane (ISSUE 7): obs-off == obs-on bit-exactness, the
+    # ledger-registration convention, Prometheus golden exposition, the
+    # five-ledgers-in-one-scrape contract — seconds on tiny nets
+    "test_obs.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
