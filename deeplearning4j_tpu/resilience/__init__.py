@@ -15,6 +15,9 @@ from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     FleetChaos,
     FleetChaosConfig,
     InjectedKill,
+    InjectedServingFault,
+    ServingChaos,
+    ServingChaosConfig,
     TransientDeviceError,
 )
 from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401

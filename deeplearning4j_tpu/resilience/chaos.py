@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -247,6 +248,138 @@ class FleetChaos:
             raise CoordinatorPartitioned(
                 f"injected membership-plane partition at round {rnd} "
                 f"({self._partition_polls_left} polls left)")
+
+
+# ---------------------------------------------------------------------------
+# Serving faults (ISSUE 8): deterministic failures for the serving
+# resilience plane (serving/resilience.py + engine/batcher/registry/decode
+# surgery) — a raising model, a hung device call (the documented
+# stale-tunnel wedge: ~0 CPU, no error), a slow dispatch, a bad rollout
+# (load/warmup raising), and a crashing decode-slot admission. Same
+# contract as ChaosConfig/FleetChaosConfig: config-driven only, never
+# ambient — an engine without a configured ServingChaos is byte-identical
+# to one built before this module existed.
+# ---------------------------------------------------------------------------
+
+
+class InjectedServingFault(RuntimeError):
+    """A chaos-injected serving failure (inference / load / warmup /
+    decode admission)."""
+
+
+@dataclass
+class ServingChaosConfig:
+    """Declarative serving fault plan. Indices are 1-based counts of the
+    engine-side event they key on — batcher DISPATCHES for the infer
+    faults (deterministic under coalescing: the k-th batch the worker
+    dispatches, regardless of which requests rode in it), decode
+    ADMISSIONS for admit_raise_at.
+
+      infer_raise_at    — dispatches [k, k+infer_raise_count) raise
+                          :class:`InjectedServingFault` (the flaky-model
+                          path: consecutive failures walk the breaker
+                          SERVING -> DEGRADED -> BROKEN).
+      infer_hang_at     — dispatch k blocks for ``infer_hang_s`` seconds
+                          (or until :meth:`ServingChaos.release_hangs`)
+                          with no error and ~0 CPU — the stale-tunnel
+                          signature the watchdog must detect. The hung
+                          call eventually RETURNS (a test must not leak a
+                          forever-thread), but by then the watchdog has
+                          failed its futures and fenced its worker, so
+                          the late completion must be a no-op.
+      slow_infer_at     — dispatch k sleeps ``slow_infer_s`` then
+                          succeeds (latency degradation WITHOUT failure:
+                          the breaker must NOT open; drain must wait).
+      load_fail_name    — registry.load(name) raises (bad rollout: the
+                          record lands BROKEN, prior serving version
+                          keeps live).
+      warmup_fail_name  — registry.warmup(name) raises (same isolation).
+      admit_raise_at    — the k-th continuous-decode slot admission
+                          raises (the crashed slot is evicted + its
+                          future failed without poisoning co-residents).
+    """
+
+    infer_raise_at: Optional[int] = None
+    infer_raise_count: int = 1
+    infer_hang_at: Optional[int] = None
+    infer_hang_s: float = 3600.0
+    slow_infer_at: Optional[int] = None
+    slow_infer_s: float = 0.0
+    load_fail_name: Optional[str] = None
+    warmup_fail_name: Optional[str] = None
+    admit_raise_at: Optional[int] = None
+
+
+class ServingChaos:
+    """Stateful executor of a :class:`ServingChaosConfig`, consulted by
+    the engine's batcher infer closure (per dispatch), the registry
+    (load/warmup) and the continuous decoder (slot admission).
+    Deterministic: the same config against the same dispatch/admission
+    sequence injects the same faults."""
+
+    def __init__(self, config: ServingChaosConfig):
+        if isinstance(config, dict):
+            config = ServingChaosConfig(**config)
+        self.config = config
+        self._dispatches = 0
+        self._admits = 0
+        self._lock = threading.Lock()
+        # a test can release an injected hang at teardown instead of
+        # leaking a sleeping daemon thread for infer_hang_s
+        self._hang_release = threading.Event()
+        self.log: list = []  # (index, fault) audit trail for tests
+
+    def release_hangs(self) -> None:
+        self._hang_release.set()
+
+    def on_infer(self) -> None:
+        """Engine-side, at each batcher dispatch, BEFORE the model call."""
+        c = self.config
+        with self._lock:
+            self._dispatches += 1
+            k = self._dispatches
+        if c.slow_infer_at is not None and k == c.slow_infer_at:
+            self.log.append((k, "slow_infer"))
+            time.sleep(c.slow_infer_s)
+        if c.infer_hang_at is not None and k == c.infer_hang_at:
+            self.log.append((k, "infer_hang"))
+            # the wedge: block quietly (~0 CPU, no error) — exactly the
+            # stale-tunnel failure mode; returns when released or after
+            # infer_hang_s so tests never leak a forever-thread
+            self._hang_release.wait(timeout=c.infer_hang_s)
+            return
+        if (c.infer_raise_at is not None
+                and c.infer_raise_at <= k
+                < c.infer_raise_at + c.infer_raise_count):
+            self.log.append((k, "infer_raise"))
+            raise InjectedServingFault(
+                f"injected inference failure at dispatch {k}")
+
+    def on_load(self, name: str) -> None:
+        """Registry-side, inside load() before the record is installed."""
+        if (self.config.load_fail_name is not None
+                and name == self.config.load_fail_name):
+            self.log.append((name, "load_fail"))
+            raise InjectedServingFault(f"injected load failure for {name!r}")
+
+    def on_warmup(self, name: str) -> None:
+        """Registry-side, at the head of warmup()."""
+        if (self.config.warmup_fail_name is not None
+                and name == self.config.warmup_fail_name):
+            self.log.append((name, "warmup_fail"))
+            raise InjectedServingFault(
+                f"injected warmup failure for {name!r}")
+
+    def on_admit(self) -> None:
+        """Decoder-side, per slot admission, BEFORE the prefill."""
+        c = self.config
+        with self._lock:
+            self._admits += 1
+            k = self._admits
+        if c.admit_raise_at is not None and k == c.admit_raise_at:
+            self.log.append((k, "admit_raise"))
+            raise InjectedServingFault(
+                f"injected decode-slot crash at admission {k}")
 
 
 def truncate_file(path: str, keep: int = 16) -> None:

@@ -29,6 +29,15 @@ package concentrates the counter-measures:
   engine.py     ServingEngine — the stdlib-HTTP front door wiring the
                 four together (/predict, /generate, /metrics, /health,
                 /models).
+  resilience.py the failure plane (ISSUE 8): per-model CircuitBreaker
+                (SERVING -> DEGRADED -> BROKEN with half-open probe
+                recovery; open == fast-fail 503 + Retry-After) and the
+                InferenceWatchdog that detects the documented
+                stale-tunnel wedge (a hung device call: ~0 CPU, no
+                error), fails the in-flight futures with a diagnosis and
+                replaces the wedged worker. Graceful drain + SIGTERM
+                wiring live on the engine; deterministic fault injection
+                in resilience/chaos.ServingChaosConfig.
 
 streaming/serving.py's ModelServer remains the compatibility surface: a
 thin subclass of ServingEngine with the original single-model contract.
@@ -41,16 +50,32 @@ from deeplearning4j_tpu.serving.batcher import (
 )
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    ClientRequestError,
+    DrainingError,
+    InferenceWatchdog,
+    ModelWedgedError,
+    WorkerDeadError,
+)
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
 __all__ = [
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "ClientRequestError",
     "ContinuousDecoder",
+    "DrainingError",
     "DynamicBatcher",
+    "InferenceWatchdog",
     "ModelRegistry",
+    "ModelWedgedError",
     "QueueFullError",
     "RequestTimeoutError",
     "ServingEngine",
     "ServingStats",
+    "WorkerDeadError",
 ]
 
 

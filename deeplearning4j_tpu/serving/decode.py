@@ -53,6 +53,7 @@ from deeplearning4j_tpu.models.transformer import (
 )
 from deeplearning4j_tpu.ops import dispatch
 from deeplearning4j_tpu.serving.batcher import RequestTimeoutError
+from deeplearning4j_tpu.serving.resilience import WorkerDeadError
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
 
@@ -190,7 +191,8 @@ class ContinuousDecoder:
 
     def __init__(self, lm, slots: int = 4,
                  stats: Optional[ServingStats] = None,
-                 default_timeout_s: float = 300.0) -> None:
+                 default_timeout_s: float = 300.0,
+                 chaos=None) -> None:
         cfg = lm._run_cfg
         if lm.mesh is not None:
             raise ValueError("continuous decode needs a single-device LM "
@@ -221,6 +223,12 @@ class ContinuousDecoder:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._running = True
+        # serving resilience (ISSUE 8): deterministic fault injection at
+        # slot admission (resilience/chaos.ServingChaos.on_admit) and a
+        # dead-worker marker so submit() fast-fails instead of queueing
+        # prompts nobody will decode
+        self._chaos = chaos
+        self._dead: Optional[str] = None
         self._tick = _tick_for(cfg)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="continuous-decoder")
@@ -245,6 +253,10 @@ class ContinuousDecoder:
         with self._cond:
             if not self._running:
                 raise RuntimeError("decoder is stopped")
+            if self._dead is not None:
+                raise WorkerDeadError(
+                    f"decoder worker died ({self._dead}); prompts would "
+                    "queue forever")
             self._pending.append(req)
             self.stats.set_queue_depth(len(self._pending), "decode")
             self._cond.notify_all()
@@ -306,6 +318,56 @@ class ContinuousDecoder:
             jnp.asarray(slot_idx, jnp.int32))
 
     def _run(self) -> None:
+        try:
+            self._run_inner()
+        except Exception as e:  # noqa: BLE001 — worker loop boundary
+            # an uncaught error in the decode loop used to kill the
+            # worker silently (every active slot and queued prompt then
+            # waited out its full deadline). Fail everything with the
+            # real cause and mark the decoder dead so submit fast-fails.
+            with self._cond:
+                self._dead = f"{type(e).__name__}: {e}"
+                victims = [st for st in self._slots if st is not None]
+                self._slots = [None] * self.slots
+                victims.extend(self._pending)
+                self._pending.clear()
+                # reset the gauge with the queue: a dead decoder must
+                # not report the phantom backlog it just failed
+                self.stats.set_queue_depth(0, "decode")
+                self._cond.notify_all()
+            self.stats.record_worker_death()
+            err = WorkerDeadError(f"decoder worker died: {self._dead}")
+            for v in victims:
+                if not v.future.done():
+                    v.future.set_exception(err)
+
+    def _fail_active_slots(self, exc: Exception) -> None:
+        """Pool-wide device failure (the tick program covers every slot):
+        fail each active future with the real cause and free the pool —
+        the decoder itself stays alive for fresh traffic."""
+        with self._cond:
+            victims = [st for st in self._slots if st is not None]
+            self._slots = [None] * self.slots
+            self._cond.notify_all()
+        for st in victims:
+            if not st.future.done():
+                st.future.set_exception(exc)
+
+    def drain(self, timeout_s: float = 20.0) -> bool:
+        """Graceful-drain support (admission is the engine's to stop):
+        bounded wait for the pending queue and every slot to empty."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        with self._cond:
+            while (self._pending or any(st is not None
+                                        for st in self._slots)) \
+                    and self._dead is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+            return self._dead is None
+
+    def _run_inner(self) -> None:
         while True:
             with self._cond:
                 now = time.monotonic()
@@ -348,13 +410,35 @@ class ContinuousDecoder:
                     self._cond.wait()
                     continue
             for i, buf, width in admits:
-                self._admit_prefill(i, buf, width)
+                try:
+                    if self._chaos is not None:
+                        self._chaos.on_admit()
+                    self._admit_prefill(i, buf, width)
+                except Exception as e:  # noqa: BLE001 — slot isolation boundary
+                    # a crashed admission evicts ONLY its own slot: the
+                    # prefill wrote (at most) that slot's cache rows, and
+                    # per-slot math is row-independent, so co-residents'
+                    # tokens are untouched (the slot-independence
+                    # contract, tests/test_serving_resilience.py)
+                    with self._cond:
+                        st, self._slots[i] = self._slots[i], None
+                        self._cond.notify_all()
+                    if st is not None and not st.future.done():
+                        st.future.set_exception(e)
+                    self.stats.record_slot_crash()
+                    active = [j for j in active if j != i]
+            if not active:
+                continue
             # one fixed-shape device tick for the whole pool (no lock held)
-            self._cache, nxt, keys = self._tick(
-                self.lm.params, self._cache, jnp.asarray(self._tok),
-                jnp.asarray(self._pos), jnp.asarray(self._keys),
-                jnp.asarray(self._temps))
-            nxt = np.asarray(nxt)
+            try:
+                self._cache, nxt, keys = self._tick(
+                    self.lm.params, self._cache, jnp.asarray(self._tok),
+                    jnp.asarray(self._pos), jnp.asarray(self._keys),
+                    jnp.asarray(self._temps))
+                nxt = np.asarray(nxt)
+            except Exception as e:  # noqa: BLE001 — device boundary
+                self._fail_active_slots(e)
+                continue
             self._keys = np.array(keys)  # writable copy (slot admits write)
             with self._cond:
                 for i in active:
@@ -373,3 +457,4 @@ class ContinuousDecoder:
                             self.stats.record_latency(
                                 time.monotonic() - st.enqueued)
                         self._slots[i] = None  # evict; slot is free
+                self._cond.notify_all()  # drain() waiters see evictions

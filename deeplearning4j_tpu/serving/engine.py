@@ -31,20 +31,43 @@ Env knobs (read at engine construction):
   DL4J_TPU_SERVE_TIMEOUT_S   default per-request deadline (default 60)
   DL4J_TPU_SERVE_SLOTS       continuous-decode slot pool size (default 4)
   DL4J_TPU_SERVE_CONTINUOUS  "0" routes /generate to lm.generate always
+
+Resilience plane (ISSUE 8 — serving/resilience.py):
+  DL4J_TPU_SERVE_BREAKER_FAILS consecutive inference failures that open a
+                             model's circuit breaker (default 5; 0
+                             disables). Open breaker -> requests fast-fail
+                             HTTP 503 + Retry-After instead of piling
+                             onto a doomed queue; after the cooldown one
+                             half-open probe closes it on success.
+  DL4J_TPU_SERVE_WATCHDOG_S  in-flight dispatch wall deadline (default
+                             30; 0 disables): a hung device call (the
+                             stale-tunnel wedge) fails its futures with a
+                             diagnosis, trips the breaker, journals
+                             serve.wedged and replaces the worker thread.
+  DL4J_TPU_SERVE_DRAIN_S     graceful-drain deadline (default 20):
+                             stop(drain=True) / SIGTERM stops admission
+                             (503), drains admitted work to completion,
+                             then flushes the obs journal — the serving
+                             twin of ResilientTrainer's
+                             checkpoint-before-death.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import math
 import os
+import signal
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.obs import journal as obs_journal
 from deeplearning4j_tpu.obs import registry as obs_registry
 from deeplearning4j_tpu.obs import trace as obs_trace
 from deeplearning4j_tpu.obs.exporter import PROMETHEUS_CONTENT_TYPE
@@ -54,15 +77,19 @@ from deeplearning4j_tpu.serving.batcher import (
     RequestTimeoutError,
 )
 from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+    ClientRequestError,
+    DrainingError,
+    ModelWedgedError,
+    WorkerDeadError,
+    _env_float,
+    breaker_fails_default,
+    drain_s_default,
+    watchdog_s_default,
+)
 from deeplearning4j_tpu.serving.telemetry import ServingStats
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name, "").strip()
-    try:
-        return float(v) if v else default
-    except ValueError:
-        return default
 
 
 class ServingEngine:
@@ -72,7 +99,13 @@ class ServingEngine:
                  max_wait_ms: Optional[float] = None,
                  queue_capacity: Optional[int] = None,
                  request_timeout_s: Optional[float] = None,
-                 slots: Optional[int] = None) -> None:
+                 slots: Optional[int] = None,
+                 breaker_fails: Optional[int] = None,
+                 breaker_cooldown_s: float = 2.0,
+                 watchdog_s: Optional[float] = None,
+                 drain_s: Optional[float] = None,
+                 chaos=None,
+                 handle_signals: bool = False) -> None:
         self.max_batch = int(max_batch if max_batch is not None
                              else _env_float("DL4J_TPU_SERVE_MAX_BATCH", 64))
         self.max_wait_ms = (max_wait_ms if max_wait_ms is not None
@@ -101,7 +134,20 @@ class ServingEngine:
         self.stats.on_latency = lambda s: _metrics.histogram(
             "dl4j_serving_latency_seconds", s)
         self._rid = itertools.count(1)  # observability request ids
-        self.registry = ModelRegistry()
+        # -- resilience plane (serving/resilience.py) ---------------------
+        self.breaker_fails = int(breaker_fails if breaker_fails is not None
+                                 else breaker_fails_default())
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.watchdog_s = float(watchdog_s if watchdog_s is not None
+                                else watchdog_s_default())
+        self.drain_s = float(drain_s if drain_s is not None
+                             else drain_s_default())
+        self.chaos = chaos  # resilience/chaos.ServingChaos, never ambient
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._draining = False   # admission gate (checked per request)
+        self._drained = False    # a full drain() pass already ran
+        self._old_handlers: Dict[int, Any] = {}
+        self.registry = ModelRegistry(chaos=chaos, stats=self.stats)
         self._batchers: Dict[str, DynamicBatcher] = {}
         self._decoders: Dict[str, Any] = {}
         self._no_decoder: set = set()  # records probed and found ineligible
@@ -120,6 +166,8 @@ class ServingEngine:
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        if handle_signals:
+            self.install_signal_handlers()
 
     # -- compatibility surface (streaming/serving.ModelServer) ------------
     @property
@@ -138,9 +186,34 @@ class ServingEngine:
         enabled, the locked direct path otherwise)."""
         return self.predict_for(None, None, x, timeout_s=timeout_s)
 
+    def _admit(self, rec) -> CircuitBreaker:
+        """Per-request admission gate: draining engine and broken/open
+        models fast-fail with a 503-class error BEFORE the request costs
+        a queue slot — the whole point of the breaker is that a doomed
+        queue never forms. Returns the model's breaker (check() already
+        ran; a half-open probe rides through like any admitted request —
+        its outcome closes or re-opens the breaker)."""
+        if self._draining:
+            self.stats.record_fast_fail()
+            raise DrainingError("engine is draining; admission closed")
+        if rec.state == "broken":
+            # load/warmup-broken: no probe can rehabilitate a record that
+            # never compiled — the operator reloads/re-warms (registry)
+            self.stats.record_fast_fail()
+            raise BreakerOpenError(
+                f"model {rec.key} is broken ({rec.error}); reload or "
+                "re-warm it", retry_after_s=5.0)
+        breaker = self._breaker_for(rec)
+        breaker.check()
+        return breaker
+
     def predict_for(self, name, version, x,
                     timeout_s: Optional[float] = None) -> np.ndarray:
         rec = self.registry.get(name, version)
+        # admission BEFORE the unloaded check: a broken record (failed
+        # rollout, model None) must answer 503-with-Retry-After, not a
+        # 400 that reads like a client mistake
+        breaker = self._admit(rec)
         if rec.model is None:
             raise KeyError(f"{rec.key} is unloaded")
         x = np.asarray(x)
@@ -148,7 +221,17 @@ class ServingEngine:
         with obs_trace.span("serve.request", rid=rid, model=rec.key,
                             rows=int(x.shape[0])):
             if not self.batching_enabled:
-                return self._direct_output(rec, x)
+                # naive path: outcome accounting at the call boundary
+                # (the batcher path records per DISPATCH via on_outcome)
+                try:
+                    out = self._direct_output(rec, x)
+                except ClientRequestError:
+                    raise  # payload error: no vote either way
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    breaker.record_failure(f"{type(e).__name__}: {e}")
+                    raise
+                breaker.record_success()
+                return out
             batcher = self._batcher_for(rec)
             # rid threads THROUGH the batcher: the serve.batch span on
             # the worker thread lists it, joining this request's span to
@@ -165,12 +248,29 @@ class ServingEngine:
         filters, mesh-sharded or MoE models (the filters are compiled
         per-(n_new, k) there — models/transformer._filter_logits)."""
         rec = self.registry.get(name, version)
+        breaker = self._admit(rec)
         model = rec.model
         if model is None or not hasattr(model, "generate"):
-            raise ValueError(f"model {rec.key} has no generate()")
+            # addressing a non-LM model is the CLIENT's mistake — it
+            # must not vote on (or ghost-probe) the model's health
+            raise ClientRequestError(f"model {rec.key} has no generate()")
         tokens = np.asarray(tokens, np.int32)
         if tokens.ndim == 1:
             tokens = tokens[None]
+        try:
+            out = self._generate_inner(rec, model, tokens, n_new,
+                                       temperature, seed, top_k, top_p)
+        except (RequestTimeoutError, FutureTimeoutError,
+                ClientRequestError):
+            raise  # deadlines/payloads are not model-health evidence
+        except Exception as e:  # noqa: BLE001 — serving boundary
+            breaker.record_failure(f"{type(e).__name__}: {e}")
+            raise
+        breaker.record_success()
+        return out
+
+    def _generate_inner(self, rec, model, tokens, n_new, temperature,
+                        seed, top_k, top_p) -> np.ndarray:
         decoder = (self._decoder_for(rec)
                    if top_k is None and top_p is None else None)
         if decoder is not None:
@@ -205,30 +305,65 @@ class ServingEngine:
             return x
         return rec.normalizer.transform_array(x)
 
+    @staticmethod
+    def _shape_rows(rec, x: np.ndarray) -> np.ndarray:
+        """Pre-dispatch input shaping (reshape + fitted normalizer). A
+        failure HERE is the client's payload, not the model's health —
+        wrapped as ClientRequestError so the breaker vote skips it (the
+        HTTP layer still answers 400 like any payload error)."""
+        try:
+            if rec.input_shape is not None:
+                x = x.reshape((x.shape[0],) + rec.input_shape)
+            return ServingEngine._normalize_rows(rec, x)
+        except Exception as e:  # noqa: BLE001 — input boundary
+            raise ClientRequestError(
+                f"bad request rows for {rec.key}: "
+                f"{type(e).__name__}: {e}") from e
+
     def _direct_output(self, rec, x: np.ndarray) -> np.ndarray:
         """The naive per-request path the batcher replaces (kept for the
         DL4J_TPU_SERVE_BATCH=0 comparison and the bench's baseline): one
         locked output() dispatch per call."""
-        if rec.input_shape is not None:
-            x = x.reshape((x.shape[0],) + rec.input_shape)
-        x = self._normalize_rows(rec, x)
+        x = self._shape_rows(rec, x)
         with self._lock:
             out = rec.model.output(x)
         out0 = out[0] if isinstance(out, (list, tuple)) else out
         return np.asarray(out0)
 
+    def _breaker_for(self, rec) -> CircuitBreaker:
+        with self._engine_lock:
+            breaker = self._breakers.get(rec.key)
+            if breaker is None:
+
+                def on_transition(old, new, reason, _key=rec.key):
+                    # the health timeline rides the flight recorder: a
+                    # post-mortem of a degraded endpoint starts from
+                    # WHEN each model broke/recovered and why
+                    obs_journal.event("serve.health", model=_key,
+                                      old=old, new=new, reason=reason)
+
+                breaker = CircuitBreaker(
+                    fails=self.breaker_fails,
+                    cooldown_s=self.breaker_cooldown_s,
+                    key=rec.key, stats=self.stats,
+                    on_transition=on_transition)
+                self._breakers[rec.key] = breaker
+            return breaker
+
     def _batcher_for(self, rec) -> DynamicBatcher:
         with self._engine_lock:
             batcher = self._batchers.get(rec.key)
             if batcher is None:
-                shape = rec.input_shape
                 model = rec.model
+                chaos = self.chaos
 
-                def infer(batch, _rec=rec, _model=model, _shape=shape):
-                    batch = np.asarray(batch)
-                    if _shape is not None:
-                        batch = batch.reshape((batch.shape[0],) + _shape)
-                    batch = self._normalize_rows(_rec, batch)
+                def infer(batch, _rec=rec, _model=model):
+                    if chaos is not None:
+                        # per-DISPATCH injection point (deterministic
+                        # under coalescing); a configured hang blocks
+                        # right here — exactly where a stale tunnel would
+                        chaos.on_infer()
+                    batch = self._shape_rows(_rec, np.asarray(batch))
                     out = _model.output(batch)
                     out0 = out[0] if isinstance(out, (list, tuple)) else out
                     return np.asarray(out0)
@@ -238,9 +373,47 @@ class ServingEngine:
                     max_wait_ms=self.max_wait_ms,
                     queue_capacity=self.queue_capacity,
                     default_timeout_s=self.request_timeout_s,
-                    stats=self.stats)
+                    stats=self.stats,
+                    watchdog_s=self.watchdog_s,
+                    on_outcome=self._outcome_hook(rec),
+                    on_wedged=self._wedged_hook(rec))
                 self._batchers[rec.key] = batcher
             return batcher
+
+    def _outcome_hook(self, rec):
+        """Per-dispatch breaker feed for rec's batcher."""
+        def on_outcome(ok: bool, exc, _key_rec=rec):
+            breaker = self._breaker_for(_key_rec)
+            if ok:
+                breaker.record_success()
+            elif isinstance(exc, ClientRequestError):
+                # a malformed payload is 400-class CLIENT evidence: it
+                # failed before the model dispatch and must not walk a
+                # healthy model toward BROKEN (nor count as a success)
+                pass
+            elif isinstance(exc, WorkerDeadError):
+                # a dead worker is categorical, not a vote: nothing will
+                # dispatch for this model until an operator intervenes,
+                # and /health must say so now
+                breaker.trip(f"{exc}")
+            else:
+                breaker.record_failure(f"{type(exc).__name__}: {exc}")
+        return on_outcome
+
+    def _wedged_hook(self, rec):
+        """Watchdog verdict for rec's batcher: categorical evidence — trip
+        the breaker (no vote counting) and journal the wedge so a dead
+        tunnel leaves a readable timeline even if the process dies next."""
+        def on_wedged(info, _key_rec=rec):
+            self._breaker_for(_key_rec).trip(
+                f"watchdog: {info['error']}")
+            obs_journal.event(
+                "serve.wedged", model=_key_rec.key,
+                rows=int(info["rows"]),
+                failed_requests=int(info["failed_requests"]),
+                watchdog_s=float(info["watchdog_s"]))
+            obs_journal.flush(fsync=True)
+        return on_wedged
 
     def _decoder_for(self, rec):
         if not self.continuous_enabled:
@@ -262,7 +435,8 @@ class ServingEngine:
                 try:
                     decoder = ContinuousDecoder(
                         rec.model, slots=self.slots, stats=self.stats,
-                        default_timeout_s=max(self.request_timeout_s, 300.0))
+                        default_timeout_s=max(self.request_timeout_s, 300.0),
+                        chaos=self.chaos)
                 except ValueError:
                     self._no_decoder.add(rec.key)
                     return None
@@ -277,11 +451,13 @@ class ServingEngine:
             def log_message(self, *a):
                 pass
 
-            def _send(self, code: int, obj):
+            def _send(self, code: int, obj, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -291,14 +467,11 @@ class ServingEngine:
 
             def do_GET(self):
                 if self.path == "/health":
-                    rec = engine.registry.default()
-                    self._send(200, {
-                        "ok": True,
-                        "model": (type(rec.model).__name__
-                                  if rec is not None else None),
-                        "models": [r["name"] + "@v" + str(r["version"])
-                                   for r in engine.registry.describe()],
-                    })
+                    # real health, not a constant: per-model states, and
+                    # HTTP 503 when nothing can serve (all broken, or
+                    # draining) so a load balancer actually routes away
+                    code, body = engine.health()
+                    self._send(code, body)
                 elif self.path.split("?")[0] == "/metrics":
                     # content negotiation: a Prometheus scraper (Accept:
                     # text/plain / openmetrics, or an explicit
@@ -342,6 +515,25 @@ class ServingEngine:
                 except QueueFullError as e:
                     # rejected counter already bumped at submit()
                     self._send(429, {"error": f"QueueFull: {e}"})
+                except (BreakerOpenError, DrainingError) as e:
+                    # fast-fail counter already bumped at the admission
+                    # gate; Retry-After is the shed contract — a client
+                    # library backs off instead of hammering a breaker.
+                    # RFC 9110 delta-seconds is an INTEGER: a fractional
+                    # value is silently dropped by standard retry
+                    # parsers, so round sub-second cooldowns UP to 1
+                    self._send(503, {"error": f"Unavailable: {e}"},
+                               headers={"Retry-After": str(max(
+                                   1, math.ceil(e.retry_after_s)))})
+                except ModelWedgedError as e:
+                    # the watchdog's diagnosis — NOT a 504-by-rot: the
+                    # client learns the dispatch hung (stale tunnel), not
+                    # that it merely queued too long
+                    self._send(503, {"error": f"Wedged: {e}"},
+                               headers={"Retry-After": "1"})
+                except WorkerDeadError as e:
+                    self._send(503, {"error": f"WorkerDead: {e}"},
+                               headers={"Retry-After": "1"})
                 except RequestTimeoutError as e:
                     # timeout counter already bumped where it expired
                     # (batcher worker / batcher.predict / decoder loop)
@@ -429,7 +621,48 @@ class ServingEngine:
 
     def metrics(self) -> Dict[str, Any]:
         return {"serving": self.stats.snapshot(),
-                "models": self.registry.describe()}
+                "models": self.registry.describe(),
+                "health": self.model_health(),
+                "draining": self._draining}
+
+    def model_health(self) -> Dict[str, str]:
+        """Per-model health: the breaker's verdict when the model has
+        taken traffic, the registry lifecycle state otherwise (a
+        load/warmup-broken record reads ``broken`` either way)."""
+        out: Dict[str, str] = {}
+        with self._engine_lock:
+            breakers = dict(self._breakers)
+        for d in self.registry.describe():
+            key = f"{d['name']}@v{d['version']}"
+            if d["state"] in ("broken", "unloaded"):
+                out[key] = d["state"]
+                continue
+            breaker = breakers.get(key)
+            out[key] = breaker.state if breaker is not None else d["state"]
+        return out
+
+    def health(self):
+        """(http_code, body) for /health: 503 when the engine cannot take
+        traffic — draining, or every loaded model broken — so a load
+        balancer's probe actually routes away; 200 otherwise (including
+        the no-models bootstrap state, which is healthy-but-empty)."""
+        health = self.model_health()
+        live = [k for k, v in health.items()
+                if v not in ("broken", "unloaded")]
+        loaded = [k for k, v in health.items() if v != "unloaded"]
+        ok = not self._draining and (bool(live) or not loaded)
+        rec = self.registry.default()
+        body = {
+            "ok": ok,
+            "draining": self._draining,
+            "model": (type(rec.model).__name__
+                      if rec is not None and rec.model is not None
+                      else None),
+            "models": [r["name"] + "@v" + str(r["version"])
+                       for r in self.registry.describe()],
+            "health": health,
+        }
+        return (200 if ok else 503), body
 
     def retire(self, name, version=None) -> None:
         """Unload a record AND tear down its batcher/decoder."""
@@ -438,6 +671,7 @@ class ServingEngine:
             batcher = self._batchers.pop(rec.key, None)
             decoder = self._decoders.pop(rec.key, None)
             self._no_decoder.discard(rec.key)
+            self._breakers.pop(rec.key, None)
         if batcher is not None:
             batcher.stop()
         if decoder is not None:
@@ -451,8 +685,49 @@ class ServingEngine:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
-        self._httpd.shutdown()
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful drain: close admission (new requests 503 at the
+        _admit gate), then wait — bounded by DL4J_TPU_SERVE_DRAIN_S — for
+        every ADMITTED request to complete (batcher queues + in-flight,
+        decoder pending + slots), and flush the obs journal so the
+        timeline survives whatever comes next. The serving twin of
+        ResilientTrainer's checkpoint-before-death. True when everything
+        admitted was answered within the deadline."""
+        budget = float(timeout_s if timeout_s is not None else self.drain_s)
+        self._draining = True
+        obs_journal.event("serve.drain", drain_s=budget)
+        deadline = time.monotonic() + budget
+        with self._engine_lock:
+            batchers = list(self._batchers.values())
+            decoders = list(self._decoders.values())
+        ok = True
+        for b in batchers:
+            ok = b.drain(max(0.0, deadline - time.monotonic())) and ok
+        for d in decoders:
+            ok = d.drain(max(0.0, deadline - time.monotonic())) and ok
+        self.stats.record_drain(ok)
+        obs_journal.event("serve.drain_complete", completed=ok)
+        obs_journal.flush(fsync=True)
+        self._drained = True
+        return ok
+
+    def stop(self, drain: bool = True,
+             drain_timeout_s: Optional[float] = None) -> None:
+        """Shutdown. ``drain=True`` (the default) answers everything
+        already admitted before tearing down; ``drain=False`` is the
+        old immediate stop (still fails — never abandons — queued and
+        in-flight futures via the batcher/decoder stop contracts).
+        Gated on ``_drained``, not the admission flag: the SIGTERM
+        handler closes admission BEFORE the drain runs, and that must
+        not suppress the drain itself."""
+        if drain and not self._drained:
+            self.drain(drain_timeout_s)
+        self._draining = True
+        self.restore_signal_handlers()
+        if self._thread is not None:
+            # shutdown() handshakes with a RUNNING serve_forever loop —
+            # on a never-started engine it would block forever
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -465,6 +740,40 @@ class ServingEngine:
             b.stop()
         for d in decoders:
             d.stop()
+
+    # -- preemption (the ResilientTrainer SIGTERM discipline) -------------
+    def install_signal_handlers(self, signals=(signal.SIGTERM,)) -> None:
+        """Wire graceful drain to preemption signals. Main thread only
+        (the signal module's rule — same constraint ResilientTrainer
+        documents); raises ValueError elsewhere."""
+        for sig in signals:
+            self._old_handlers[sig] = signal.signal(sig, self._on_signal)
+
+    def restore_signal_handlers(self) -> None:
+        for sig in list(self._old_handlers):
+            try:
+                signal.signal(sig, self._old_handlers[sig])
+            except ValueError:
+                # not the main thread (a drain thread's stop()): KEEP the
+                # saved handler so a later main-thread stop can restore
+                continue
+            del self._old_handlers[sig]
+
+    def _on_signal(self, signum, frame) -> None:
+        # admission closes IN the handler (one flag write — safe in
+        # signal context); EVERYTHING else — journaling included — runs
+        # on the worker thread. The journal's append lock is a plain
+        # non-reentrant Lock: the handler runs on the main thread
+        # between bytecodes, and if that thread was mid-append when the
+        # signal landed, taking the lock here would deadlock the whole
+        # process at the exact moment it is being preempted.
+        self._draining = True
+        threading.Thread(target=self._preempt_stop, args=(int(signum),),
+                         daemon=True, name="serve-drain").start()
+
+    def _preempt_stop(self, signum: int) -> None:
+        obs_journal.event("serve.preempt", signum=signum)
+        self.stop(drain=True)
 
     @property
     def url(self) -> str:

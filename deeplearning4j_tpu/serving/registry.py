@@ -20,6 +20,16 @@ lifecycle to be data, not deployment:
   unload   drop the registry's references and DELETE the device buffers
            (jax array .delete()), so a retired version's params/optimizer
            HBM is reclaimed immediately instead of at GC's leisure.
+
+Failure isolation (ISSUE 8 — the rollback primitive ROADMAP item 5's
+shadow-eval promotion stands on): a load/warmup exception no longer
+propagates with no per-model record — the record lands in state
+``broken`` (with the error preserved for /models), the exception is
+re-raised to the caller, and crucially the PRIOR serving version is
+untouched: the default traffic target never moves on a failed rollout,
+and ``serve()`` refuses to promote a broken record. Deterministic fault
+injection: resilience/chaos.ServingChaosConfig (load_fail_name /
+warmup_fail_name), consulted only when a chaos object is configured.
 """
 
 from __future__ import annotations
@@ -59,6 +69,7 @@ class ModelRecord:
         # travel WITH the model (checkpoint zip normalizer.json section)
         self.normalizer = normalizer
         self.state = "loaded"
+        self.error: Optional[str] = None  # set when state == "broken"
         self.loaded_ts = time.strftime("%Y-%m-%dT%H:%M:%S")
         self.warmed_buckets: List[int] = []
 
@@ -76,6 +87,8 @@ class ModelRecord:
             "loaded_ts": self.loaded_ts,
             "warmed_buckets": list(self.warmed_buckets),
         }
+        if self.error is not None:
+            out["error"] = self.error
         if self.input_shape:
             out["input_shape"] = list(self.input_shape)
         if self.normalizer is not None:
@@ -87,10 +100,15 @@ class ModelRecord:
 
 
 class ModelRegistry:
-    def __init__(self) -> None:
+    def __init__(self, chaos=None, stats=None) -> None:
         self._lock = threading.RLock()
         self._records: Dict[str, Dict[int, ModelRecord]] = {}
         self._default: Optional[Tuple[str, int]] = None
+        # serving resilience wiring (both optional): the chaos monkey
+        # injects load/warmup faults deterministically; the stats ledger
+        # (serving/telemetry.ServingStats) counts the isolations
+        self.chaos = chaos
+        self.stats = stats
 
     # -- lifecycle --------------------------------------------------------
     def load(self, name: str, model=None, model_path: Optional[str] = None,
@@ -99,19 +117,36 @@ class ModelRegistry:
         version is auto-assigned (monotonic per name, starting at 1).
         A checkpoint zip's optional normalizer section is picked up
         automatically (an explicit ``normalizer`` wins) so /predict
-        applies the exact statistics the model trained under."""
-        if model is None:
-            if model_path is None:
-                raise ValueError("need model or model_path")
-            from deeplearning4j_tpu.utils.serialization import ModelSerializer
+        applies the exact statistics the model trained under.
 
-            model = ModelSerializer.restore(model_path)
-        if normalizer is None and model_path is not None:
-            from deeplearning4j_tpu.utils.serialization import (
-                read_normalizer,
-            )
+        A restore that RAISES is isolated, not propagated bare: the
+        version lands as a BROKEN record (error preserved, model None)
+        and the exception re-raises — the default traffic target never
+        moves, so the previously serving version keeps taking requests
+        (the rollback primitive)."""
+        if model is None and model_path is None:
+            raise ValueError("need model or model_path")
+        try:
+            if self.chaos is not None:
+                self.chaos.on_load(name)
+            if model is None:
+                from deeplearning4j_tpu.utils.serialization import (
+                    ModelSerializer,
+                )
 
-            normalizer = read_normalizer(model_path)
+                model = ModelSerializer.restore(model_path)
+            if normalizer is None and model_path is not None:
+                from deeplearning4j_tpu.utils.serialization import (
+                    read_normalizer,
+                )
+
+                normalizer = read_normalizer(model_path)
+        except Exception as e:
+            self._record_broken(name, e, input_shape=input_shape,
+                                path=model_path)
+            if self.stats is not None:
+                self.stats.record_load_failure()
+            raise
         with self._lock:
             versions = self._records.setdefault(name, {})
             version = max(versions) + 1 if versions else 1
@@ -123,6 +158,21 @@ class ModelRegistry:
             # switches traffic (the documented load -> warmup -> serve
             # lifecycle — a cold record must never take requests because
             # it happened to be loaded first)
+            return rec
+
+    def _record_broken(self, name: str, exc: Exception, *,
+                       input_shape=None, path=None) -> ModelRecord:
+        """Install a BROKEN record for a failed load so the rollout
+        attempt is auditable at /models instead of vanishing into the
+        caller's traceback. Never touches the serving default."""
+        with self._lock:
+            versions = self._records.setdefault(name, {})
+            version = max(versions) + 1 if versions else 1
+            rec = ModelRecord(name, version, None,
+                              input_shape=input_shape, path=path)
+            rec.state = "broken"
+            rec.error = f"{type(exc).__name__}: {exc}"
+            versions[version] = rec
             return rec
 
     def warmup(self, name: Optional[str] = None,
@@ -151,25 +201,46 @@ class ModelRegistry:
                 f"{rec.key}: warmup needs input_shape or sample_row")
         t0 = time.perf_counter()
         ladder = bucket_ladder(max_batch)
-        for b in ladder:
-            batch = np.broadcast_to(row, (b,) + row.shape)
-            out = model.output(batch)
-            np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
-        if gen_tokens and hasattr(model, "generate"):
-            np.asarray(model.generate(
-                np.zeros((1, 2), np.int32), int(gen_tokens)))
+        try:
+            if self.chaos is not None:
+                self.chaos.on_warmup(rec.name)
+            for b in ladder:
+                batch = np.broadcast_to(row, (b,) + row.shape)
+                out = model.output(batch)
+                np.asarray(out[0] if isinstance(out, (list, tuple)) else out)
+            if gen_tokens and hasattr(model, "generate"):
+                np.asarray(model.generate(
+                    np.zeros((1, 2), np.int32), int(gen_tokens)))
+        except Exception as e:
+            # a model that cannot compile/run its bucket ladder must not
+            # take traffic: BROKEN, error preserved, prior serving
+            # version untouched (warmup never promotes)
+            with self._lock:
+                rec.state = "broken"
+                rec.error = f"{type(e).__name__}: {e}"
+            if self.stats is not None:
+                self.stats.record_warmup_failure()
+            raise
         dt = time.perf_counter() - t0
         with self._lock:
             rec.warmed_buckets = ladder
-            if rec.state == "loaded":
+            if rec.state in ("loaded", "broken"):
+                # a broken-at-warmup record that now warms clean is
+                # rehabilitated — the operator's re-warm IS the probe
                 rec.state = "warm"
+                rec.error = None
         return {"model": rec.key, "buckets": ladder,
                 "gen_tokens": int(gen_tokens), "seconds": round(dt, 3)}
 
     def serve(self, name: Optional[str] = None,
               version: Optional[int] = None) -> ModelRecord:
-        """Make (name, version) the default traffic target."""
+        """Make (name, version) the default traffic target. Refuses a
+        broken record: promoting a failed rollout would move traffic ONTO
+        the failure the isolation just contained."""
         rec = self.get(name, version)
+        if rec.state == "broken":
+            raise ValueError(
+                f"{rec.key} is broken ({rec.error}); refusing to serve")
         if rec.model is None:
             raise ValueError(f"{rec.key} is unloaded")
         with self._lock:

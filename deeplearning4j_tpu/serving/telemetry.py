@@ -50,6 +50,21 @@ class ServingStats:
         self.batched_rows = 0      # real rows across all batches
         self.padded_rows = 0       # pad rows across all batches
         self.generated_tokens = 0  # continuous-decode output tokens
+        # -- resilience plane (serving/resilience.py): the counters the
+        # breaker/watchdog/drain paths are judged by — exported through
+        # the central MetricsRegistry like every other field here
+        self.breaker_opens = 0     # SERVING/DEGRADED -> BROKEN transitions
+        self.breaker_closes = 0    # successful half-open probe recoveries
+        self.breaker_probes = 0    # half-open probe requests admitted
+        self.fast_fails_503 = 0    # requests shed by an open breaker
+        self.wedged_batches = 0    # watchdog-expired in-flight dispatches
+        self.watchdog_restarts = 0  # worker threads replaced after a wedge
+        self.worker_deaths = 0     # worker threads dead from uncaught error
+        self.slot_crashes = 0      # decode slots evicted by a crash
+        self.load_failures = 0     # registry.load exceptions (isolated)
+        self.warmup_failures = 0   # registry.warmup exceptions (isolated)
+        self.drains_started = 0    # graceful drains begun (stop/SIGTERM)
+        self.drains_completed = 0  # drains that emptied the queues in time
         # per-component depths (batcher rows / decode pending prompts):
         # one shared last-writer-wins field would let an idle component
         # overwrite the backlog the other is about to 429 on
@@ -90,6 +105,53 @@ class ServingStats:
         with self._lock:
             self.generated_tokens += int(n)
 
+    # -- resilience plane --------------------------------------------------
+    def record_breaker_open(self) -> None:
+        with self._lock:
+            self.breaker_opens += 1
+
+    def record_breaker_close(self) -> None:
+        with self._lock:
+            self.breaker_closes += 1
+
+    def record_breaker_probe(self) -> None:
+        with self._lock:
+            self.breaker_probes += 1
+
+    def record_fast_fail(self) -> None:
+        with self._lock:
+            self.fast_fails_503 += 1
+
+    def record_wedged(self) -> None:
+        with self._lock:
+            self.wedged_batches += 1
+
+    def record_watchdog_restart(self) -> None:
+        with self._lock:
+            self.watchdog_restarts += 1
+
+    def record_worker_death(self) -> None:
+        with self._lock:
+            self.worker_deaths += 1
+
+    def record_slot_crash(self) -> None:
+        with self._lock:
+            self.slot_crashes += 1
+
+    def record_load_failure(self) -> None:
+        with self._lock:
+            self.load_failures += 1
+
+    def record_warmup_failure(self) -> None:
+        with self._lock:
+            self.warmup_failures += 1
+
+    def record_drain(self, completed: bool) -> None:
+        with self._lock:
+            self.drains_started += 1
+            if completed:
+                self.drains_completed += 1
+
     def set_queue_depth(self, depth: int,
                         component: str = "batcher") -> None:
         with self._lock:
@@ -129,6 +191,18 @@ class ServingStats:
                 "batched_rows": self.batched_rows,
                 "padded_rows": self.padded_rows,
                 "generated_tokens": self.generated_tokens,
+                "breaker_opens": self.breaker_opens,
+                "breaker_closes": self.breaker_closes,
+                "breaker_probes": self.breaker_probes,
+                "fast_fails_503": self.fast_fails_503,
+                "wedged_batches": self.wedged_batches,
+                "watchdog_restarts": self.watchdog_restarts,
+                "worker_deaths": self.worker_deaths,
+                "slot_crashes": self.slot_crashes,
+                "load_failures": self.load_failures,
+                "warmup_failures": self.warmup_failures,
+                "drains_started": self.drains_started,
+                "drains_completed": self.drains_completed,
                 "queue_depth": sum(self.queue_depths.values()),
                 "queue_depths": dict(self.queue_depths),
             }

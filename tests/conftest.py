@@ -80,6 +80,10 @@ _QUICK_FILES = {
     # ledger-registration convention, Prometheus golden exposition, the
     # five-ledgers-in-one-scrape contract — seconds on tiny nets
     "test_obs.py",
+    # serving resilience plane (ISSUE 8): chaos-driven breaker/watchdog/
+    # drain/isolation contracts — deterministic injected faults on tiny
+    # nets, the serving third of the crash-recovery convention
+    "test_serving_resilience.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
