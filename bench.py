@@ -50,6 +50,8 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.ops import env as envknob
+
 os.environ.setdefault("DL4J_TPU_OFFLINE", "")  # downloads attempted once
 
 
@@ -70,7 +72,7 @@ def _enable_compile_cache() -> None:
     # knob must win, or in-process and subprocess legs would split into
     # two divergent caches
     cache_dir = None
-    if not (os.environ.get(dispatch.ENV_CACHE, "").strip()
+    if not (envknob.raw(dispatch.ENV_CACHE, "").strip()
             or os.environ.get("JAX_COMPILATION_CACHE_DIR", "").strip()):
         cache_dir = "/root/.jax_compile_cache"
     if dispatch.enable_compile_cache(cache_dir) is None:
@@ -495,7 +497,7 @@ def bench_transformer_big(steps=3, seq=1024, d_model=2048, n_layers=8,
     WITH remat on the watcher's next contact."""
     from deeplearning4j_tpu.ops.memory import auto_fit_transformer
 
-    hbm_gb = float(os.environ.get("DL4J_TPU_HBM_GB", "16"))
+    hbm_gb = envknob.get_float("DL4J_TPU_HBM_GB", 16.0)
     cfg = _transformer_bench_cfg(seq, d_model, n_layers, heads,
                                  dtype_policy="performance")
     # accum pinned to 1 for the leg: the MFU number must stay a
@@ -1892,7 +1894,7 @@ def _w2v_corpus(vocab, sentences, sent_len):
     points at one (tokenized by the framework tokenizer, provenance
     'local' — this zero-egress host cannot download text8), else the
     deterministic zipf-ish synthetic corpus, labeled as such."""
-    path = os.environ.get("DL4J_TPU_W2V_CORPUS")
+    path = envknob.get_str("DL4J_TPU_W2V_CORPUS")
     if path and os.path.isfile(path):
         from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
 
@@ -2312,10 +2314,31 @@ def _persist_partial(extras: dict) -> None:
     try:
         with open(tmp, "w") as f:
             json.dump({"updated": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                       "graftlint_clean": _GRAFTLINT_CLEAN,
                        "legs": legs}, f, indent=1, sort_keys=True)
         os.replace(tmp, _PARTIAL_PATH)
     except OSError as e:
         _log(f"partial artifact write failed: {e}")
+
+
+#: graftlint verdict for THIS bench process's tree, stamped into every
+#: artifact it writes — true/false from the sweep, None when the linter
+#: itself failed (a provenance bit like the data labels: never fabricated)
+_GRAFTLINT_CLEAN = None
+
+
+def _graftlint_sweep():
+    global _GRAFTLINT_CLEAN
+    try:
+        from deeplearning4j_tpu.analysis import repo_clean
+        _GRAFTLINT_CLEAN = bool(repo_clean())
+    except Exception as e:  # the stamp must never take the bench down
+        _log(f"graftlint sweep failed: {e}")
+        _GRAFTLINT_CLEAN = None
+    if _GRAFTLINT_CLEAN is False:
+        _log("graftlint: tree is DIRTY — artifact rows will carry "
+             "graftlint_clean=false (scripts/bench_state.py will warn)")
+    return _GRAFTLINT_CLEAN
 
 
 def _load_partial_legs() -> dict:
@@ -2344,6 +2367,9 @@ def main():
         _log("spawning watcher's round is over; stale bench pass "
              "aborting at startup")
         raise SystemExit(3)
+    # lint provenance: stamp whether this tree passes graftlint so an
+    # artifact produced from a dirty tree says so (AST-only, ~2s, no jax)
+    _graftlint_sweep()
     quick = "--quick" in sys.argv
     # --fill: gap-filling mode for the tunnel watcher — skip legs that
     # already have a measured (non-error) row in BENCH_PARTIAL.json so a
@@ -2357,7 +2383,7 @@ def main():
             os.environ["DL4J_TPU_XPLANE_TRACE"] = "xplane_traces"
         elif a.startswith("--trace="):
             os.environ["DL4J_TPU_XPLANE_TRACE"] = a.split("=", 1)[1]
-    trace_dir = os.environ.get("DL4J_TPU_XPLANE_TRACE")
+    trace_dir = envknob.get_str("DL4J_TPU_XPLANE_TRACE")
     if only and all(name in _CPU_ONLY_LEGS for name in only):
         probe_err = None
     else:
@@ -2496,7 +2522,7 @@ def main():
         reps=1 if quick else 3)
     run("scaling_virtual8", bench_scaling)
     if only:
-        print(json.dumps(extras))
+        print(json.dumps(dict(extras, graftlint_clean=_GRAFTLINT_CLEAN)))
         return
 
     # headline: the fused training loop (fit_batches == the reference's
@@ -2526,6 +2552,7 @@ def main():
                             else None),
         "baseline_cpu_impl": ("jax-CPU LeNet-5 per-step fit vs torch-cpu "
                               "per-step, same host/core (cpu_for_cpu tier)"),
+        "graftlint_clean": _GRAFTLINT_CLEAN,
         "extras": extras,
     }
     if accel_down:

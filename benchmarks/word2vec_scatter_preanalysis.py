@@ -132,6 +132,7 @@ def run(vocab_sizes=(10_000, 71_000, 253_000), batch=2048, k_neg=5,
         # donation matches production (word2vec.py donate_argnums=(0,1)):
         # the tables update in place; un-donated timing would measure a
         # V-scaled table memcpy instead of the scatter
+        # graftlint: disable-file=donation-through-dispatch -- this pre-analysis bench deliberately measures the production donation contract (word2vec.py donate_argnums=(0,1)); tables are rebuilt between legs
         full = jax.jit(_neg_body, donate_argnums=(0, 1))
         math = jax.jit(_math_only)
         scat = jax.jit(_scatter_only, donate_argnums=(0, 1))

@@ -1,0 +1,56 @@
+"""CLI: ``python -m deeplearning4j_tpu.analysis [--json] [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error / crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from deeplearning4j_tpu.analysis.engine import (
+    DEFAULT_TARGETS,
+    all_rules,
+    run_paths,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="graftlint: project-invariant static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the repo surface: "
+                         f"{', '.join(DEFAULT_TARGETS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(all_rules(), key=lambda r: r.name):
+            print(f"{rule.name:28s} [{rule.severity:7s}] {rule.doc}")
+        print(f"{'bad-suppression':28s} [error  ] suppression without a "
+              "justification or naming an unknown rule")
+        return 0
+
+    report = run_paths(args.paths or None)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        for f in report.findings:
+            print(f.format())
+        errors = sum(1 for f in report.findings if f.severity == "error")
+        warnings = len(report.findings) - errors
+        status = "clean" if report.clean else "DIRTY"
+        print(f"graftlint: {status} — {report.files_scanned} files, "
+              f"{errors} errors, {warnings} warnings, "
+              f"{report.suppressions_used} suppressions honored",
+              file=sys.stderr)
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

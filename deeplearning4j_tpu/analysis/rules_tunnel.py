@@ -1,0 +1,270 @@
+"""Tunnel-safety and jit-discipline rules.
+
+These encode the CLAUDE.md "Environment gotchas" as checks:
+
+* the axon TPU plugin is registered at interpreter startup and a dead
+  remote tunnel makes ANY backend-initializing call (``jax.devices()``,
+  ``jax.default_backend()``, ...) hang forever with no error, so such
+  calls must never run at import time, in argument defaults, or in
+  constructors — only once work actually needs a device, after the code
+  path had a chance to pin ``jax_platforms`` to cpu;
+* ``jax.block_until_ready`` is NOT a sound completion fence through the
+  tunnel — completion must be fenced by a host readback that
+  data-depends on the result;
+* buffer donation invalidates the caller's arrays, so ``donate_argnums``
+  is only allowed inside ops/dispatch.py, which owns the no-re-read
+  contract (and its tests);
+* a traced function reading the wall clock or an unseeded RNG bakes one
+  sample into the compiled program — nondeterminism the retrace cache
+  then hides.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from deeplearning4j_tpu.analysis.engine import Finding, ParsedFile, Rule
+
+#: calls that initialize a jax backend on first use (and therefore hang
+#: on a dead tunnel) — the probe set CLAUDE.md warns about
+BACKEND_INIT_CALLS = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.default_backend",
+    "jax.process_index", "jax.process_count",
+}
+
+#: module-level calls that make later device probes safe: pinning the
+#: platform to cpu, or building the virtual mesh harness
+GUARD_CALLS = ("jax.config.update", "virtual_cpu_devices", "force_cpu")
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.config.update' for an Attribute/Name chain; None otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def _is_platform_guard(call: ast.Call) -> bool:
+    name = call_name(call) or ""
+    if name.endswith(("virtual_cpu_devices", "force_cpu")):
+        return True
+    if name == "jax.config.update" and call.args:
+        first = call.args[0]
+        return (isinstance(first, ast.Constant)
+                and first.value == "jax_platforms")
+    return False
+
+
+class _ContextVisitor(ast.NodeVisitor):
+    """Walk with a function-nesting stack so rules can ask 'is this call
+    import-time, a default arg, or inside __init__?'."""
+
+    def __init__(self):
+        self.func_stack: List[ast.AST] = []
+        self.class_stack: List[ast.ClassDef] = []
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node)
+
+    def _visit_func(self, node):
+        # defaults and decorators evaluate at DEF time (import time when
+        # the def is at module/class level)
+        for d in (list(node.args.defaults) + list(node.args.kw_defaults)
+                  + list(node.decorator_list)):
+            if d is not None:
+                self.visit(d)
+        self.func_stack.append(node)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.func_stack.pop()
+
+    def visit_Lambda(self, node):
+        self.func_stack.append(node)
+        self.visit(node.body)
+        self.func_stack.pop()
+
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node)
+        for d in node.decorator_list:
+            self.visit(d)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.class_stack.pop()
+
+    @property
+    def at_import_time(self) -> bool:
+        return not self.func_stack
+
+    @property
+    def in_init(self) -> bool:
+        return bool(self.func_stack) and getattr(
+            self.func_stack[0], "name", "") == "__init__"
+
+
+class TunnelDeviceProbe(Rule):
+    name = "tunnel-device-probe"
+    severity = "error"
+    doc = ("backend-initializing call (jax.devices()/default_backend()/...) "
+           "at import time, in a default argument, or in a constructor — "
+           "hangs forever on a dead TPU tunnel; defer to first actual use "
+           "or pin jax_platforms first")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        rule = self
+        findings: List[Finding] = []
+        guard_lines: List[int] = []
+
+        class V(_ContextVisitor):
+            def visit_Call(self, node: ast.Call):
+                name = call_name(node)
+                if name is not None and _is_platform_guard(node):
+                    if self.at_import_time:
+                        guard_lines.append(node.lineno)
+                elif name in BACKEND_INIT_CALLS:
+                    if self.at_import_time:
+                        if not any(g < node.lineno for g in guard_lines):
+                            findings.append(rule.finding(
+                                parsed, node,
+                                f"{name}() at import time initializes the "
+                                "TPU plugin (wedges on a dead tunnel); "
+                                "guard with jax.config.update("
+                                "'jax_platforms', ...) first or defer"))
+                    elif self.in_init:
+                        findings.append(rule.finding(
+                            parsed, node,
+                            f"{name}() in a constructor — resolve the "
+                            "device count lazily at first use (a master "
+                            "being configured/serialized must not touch "
+                            "the tunnel)"))
+                self.generic_visit(node)
+
+        V().visit(parsed.tree)
+        return findings
+
+
+class BlockUntilReadyFence(Rule):
+    name = "block-until-ready-fence"
+    severity = "warning"
+    doc = ("block_until_ready is not a sound completion fence through the "
+           "remote-TPU tunnel — fence with a one-element host readback "
+           "that data-depends on the result")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name.split(".")[-1] == "block_until_ready":
+                    findings.append(self.finding(
+                        parsed, node,
+                        "block_until_ready as a completion fence — through "
+                        "the tunnel it can return before the device work "
+                        "lands; use a data-dependent host readback"))
+        return findings
+
+
+class DonationThroughDispatch(Rule):
+    name = "donation-through-dispatch"
+    severity = "error"
+    doc = ("jax.jit(donate_argnums=...) outside ops/dispatch.py — all "
+           "buffer donation flows through the dispatch helpers, which own "
+           "the no-re-read contract and its tests")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        if parsed.rel.replace(os.sep, "/").endswith("ops/dispatch.py"):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.Call):
+                name = (call_name(node) or "").split(".")[-1]
+                # direct jax.jit(...) AND the decorator idiom
+                # functools.partial(jax.jit, donate_argnums=...)
+                if name == "partial":
+                    if not any(
+                            (dotted_name(a) or "").split(".")[-1] == "jit"
+                            for a in node.args):
+                        continue
+                elif name != "jit":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in ("donate_argnums", "donate_argnames"):
+                        findings.append(self.finding(
+                            parsed, node,
+                            "direct donation outside ops/dispatch.py — a "
+                            "caller that re-reads a donated arg gets "
+                            "deleted-buffer errors only on the backends "
+                            "that implement donation; route through "
+                            "dispatch.train_step_jit/instrumented_jit"))
+        return findings
+
+
+#: nondeterministic calls that must not appear inside traced functions
+NONDET_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "os.urandom", "random.random", "random.randint", "random.choice",
+    "random.shuffle", "random.uniform", "np.random.rand",
+    "np.random.randn", "np.random.randint", "np.random.normal",
+    "np.random.uniform", "np.random.permutation", "numpy.random.rand",
+    "numpy.random.randn",
+}
+
+
+class NondeterminismInJit(Rule):
+    name = "nondeterminism-in-jit"
+    severity = "error"
+    doc = ("wall clock / unseeded RNG inside a jitted function — the value "
+           "is sampled ONCE at trace time and baked into the compiled "
+           "program; thread jax.random keys or pass host values as args")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        # traced defs: decorated with *jit*, or passed by name to a call
+        # whose callee mentions jit (instrumented_jit(step), jax.jit(fn))
+        traced: List[ast.AST] = []
+        jit_arg_names: Set[str] = set()
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, ast.Call):
+                cname = (call_name(node) or "")
+                if "jit" in cname.split(".")[-1]:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            jit_arg_names.add(arg.id)
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                deco = [dotted_name(d.func) if isinstance(d, ast.Call)
+                        else dotted_name(d) for d in node.decorator_list]
+                if any(d and "jit" in d.split(".")[-1] for d in deco):
+                    traced.append(node)
+                elif node.name in jit_arg_names:
+                    traced.append(node)
+        findings: List[Finding] = []
+        for fn in traced:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if name in NONDET_CALLS:
+                        findings.append(self.finding(
+                            parsed, node,
+                            f"{name}() inside traced function "
+                            f"{getattr(fn, 'name', '<fn>')!r} is evaluated "
+                            "once at trace time, then frozen into the "
+                            "compiled program"))
+        return findings
+
+
+RULES = (TunnelDeviceProbe, BlockUntilReadyFence, DonationThroughDispatch,
+         NondeterminismInJit)

@@ -30,12 +30,14 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.datasets.iterator import DataSet, DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.ops import env as envknob
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
 def data_dir() -> Path:
-    return Path(os.environ.get("DL4J_TPU_DATA_DIR", Path.home() / ".deeplearning4j_tpu"))
+    return Path(envknob.raw("DL4J_TPU_DATA_DIR", "")
+                or Path.home() / ".deeplearning4j_tpu")
 
 
 # ---------------------------------------------------------------------------
@@ -73,7 +75,7 @@ _FETCH_FAILED: set = set()
 
 
 def _offline() -> bool:
-    return bool(os.environ.get("DL4J_TPU_OFFLINE"))
+    return envknob.nonempty("DL4J_TPU_OFFLINE")
 
 
 def _download(url: str, dest: Path, md5: Optional[str] = None, timeout: int = 60) -> bool:

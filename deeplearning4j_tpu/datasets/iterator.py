@@ -278,6 +278,7 @@ class AsyncDataSetIterator(DataSetIterator):
         self.base = base
         self.queue_size = max(1, int(queue_size))
         self.device_put = device_put
+        # graftlint: disable=ledger-registration -- adopted + registered by the container at fit time (nn/multilayer.py:688 re-adopts the ingest ledger through register_net)
         self.pipeline_stats = PipelineStats(workers=1,
                                             queue_capacity=self.queue_size)
         # resume cursor of the batch most recently DELIVERED to the

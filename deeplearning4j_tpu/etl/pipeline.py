@@ -59,6 +59,7 @@ import numpy as np
 from deeplearning4j_tpu.datasets.iterator import DataSet, DataSetIterator
 from deeplearning4j_tpu.etl.stats import PipelineStats, dataset_nbytes
 from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.ops import env as envknob
 
 WORKERS_ENV = "DL4J_TPU_PIPELINE_WORKERS"
 PREFETCH_ENV = "DL4J_TPU_PREFETCH"
@@ -72,11 +73,7 @@ DROP_SHARD = "drop"
 
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    try:
-        return int(v) if v else default
-    except ValueError:
-        return default
+    return envknob.get_int(name, default)
 
 
 def default_prefetch() -> int:
@@ -94,8 +91,8 @@ def _auto_shard() -> Optional[Tuple[int, int]]:
         PROCESS_ID_ENV,
     )
 
-    pid = os.environ.get(PROCESS_ID_ENV)
-    count = os.environ.get(NUM_PROCESSES_ENV)
+    pid = envknob.get_str(PROCESS_ID_ENV)
+    count = envknob.get_str(NUM_PROCESSES_ENV)
     if pid is None or count is None or int(count) <= 1:
         return None
     return int(pid), int(count)
@@ -244,6 +241,7 @@ class InputPipeline(DataSetIterator):
             if tp is not None:
                 head, tail = tp.split_for_pipeline()
             self._tp_head, self._tp_tail = head, tail
+        # graftlint: disable=ledger-registration -- adopted + registered by the container at fit time (nn/multilayer.py:688 re-adopts the ingest ledger through register_net)
         self.pipeline_stats = PipelineStats(
             workers=self.workers, queue_capacity=self.prefetch)
         # resume plane (delivered-batch cursor; see state()/restore_state)

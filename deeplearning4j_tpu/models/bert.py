@@ -397,6 +397,11 @@ class BertMLM:
 
         # AOT memory ledger (ops/memory.py), populated by measure_memory
         self.memory_stats = MemoryStats()
+        from deeplearning4j_tpu.obs.registry import register_net
+
+        # ledger-registration convention (PR 7): the ledger joins the
+        # central MetricsRegistry at its attach point (weakly held)
+        register_net(self)
 
     def measure_memory(self, inputs, targets,
                        weights) -> Optional[dict]:

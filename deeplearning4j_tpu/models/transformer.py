@@ -1109,6 +1109,12 @@ class TransformerLM:
         # AOT memory ledger beside the containers' dispatch_stats
         # (ops/memory.py); populated on demand by measure_memory()
         self.memory_stats = MemoryStats()
+        from deeplearning4j_tpu.obs.registry import register_net
+
+        # ledger-registration convention (PR 7): every *_stats ledger
+        # joins the central MetricsRegistry at its attach point — weakly
+        # held, so short-lived models don't leak
+        register_net(self)
 
     def _pipeline_mode(self) -> bool:
         return self.mesh is not None and PIPELINE_AXIS in self.mesh.shape

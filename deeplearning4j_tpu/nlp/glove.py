@@ -27,6 +27,7 @@ from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory, common_preproce
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
 
 
+# graftlint: disable=donation-through-dispatch -- functional-update idiom predating ops/dispatch: every caller rebinds to the returned tables and never re-reads the donated args (the no-re-read contract is structural at each call site)
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
 def _glove_step(W, b, hW, hb, wi, wj, logx, fdiff, lr, live):
     """Batched AdaGrad GloVe update on symmetric factor matrices.

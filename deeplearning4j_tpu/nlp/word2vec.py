@@ -138,6 +138,7 @@ def _cbow_body(syn0, syn1, ctx_idx, ctx_mask, points, codes, mask, alpha):
 
 # per-batch jitted HS step (used by graph/deepwalk.py and its tests; the
 # NS/CBOW bodies run only inside the fused epoch scans below)
+# graftlint: disable=donation-through-dispatch -- functional-update idiom predating ops/dispatch: every caller rebinds to the returned tables and never re-reads the donated args (the no-re-read contract is structural at each call site)
 _skipgram_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_body)
 
 
@@ -154,6 +155,7 @@ _skipgram_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_body)
 # ---------------------------------------------------------------------------
 
 
+# graftlint: disable=donation-through-dispatch -- functional-update idiom predating ops/dispatch: every caller rebinds to the returned tables and never re-reads the donated args (the no-re-read contract is structural at each call site)
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
                    static_argnames=("use_neg", "negative_k"))
 def _skipgram_epoch(syn0, syn1, syn1neg, P, C, M, table, cens, cxs,
@@ -200,6 +202,7 @@ def _skipgram_epoch(syn0, syn1, syn1neg, P, C, M, table, cens, cxs,
     return syn0, syn1, syn1neg
 
 
+# graftlint: disable=donation-through-dispatch -- functional-update idiom predating ops/dispatch: every caller rebinds to the returned tables and never re-reads the donated args (the no-re-read contract is structural at each call site)
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _cbow_epoch(syn0, syn1, P, C, M, cens, ctxs, cmasks, pair_live, alphas):
     """Scan over stacked CBOW batches (ctxs/cmasks: [NB, B, 2w])."""

@@ -24,10 +24,11 @@ the examples/tests read ``exporter.port``; a production run pins it).
 from __future__ import annotations
 
 import json
-import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+from deeplearning4j_tpu.ops import env as envknob
 
 ENV_PORT = "DL4J_TPU_OBS_PORT"
 
@@ -35,11 +36,7 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def _env_port(default: int = 0) -> int:
-    v = os.environ.get(ENV_PORT, "").strip()
-    try:
-        return int(v) if v else default
-    except ValueError:
-        return default
+    return envknob.get_int(ENV_PORT, default)
 
 
 class MetricsExporter:

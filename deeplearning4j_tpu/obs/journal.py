@@ -39,38 +39,24 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.ops import env as envknob
+
 ENV_JOURNAL = "DL4J_TPU_OBS_JOURNAL"
 ENV_JOURNAL_N = "DL4J_TPU_OBS_JOURNAL_N"
 ENV_FLUSH_S = "DL4J_TPU_OBS_FLUSH_S"
 
 
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    try:
-        return int(v) if v else default
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name, "").strip()
-    try:
-        return float(v) if v else default
-    except ValueError:
-        return default
-
-
 def default_journal_path() -> str:
     """Env path wins verbatim; the default gains a per-process suffix
     when this process is a multihost/fleet member (the multihost env
-    contract's process id — read directly to keep obs jax-free): N
-    OS-process workers sharing one cwd must not last-writer-wins
-    clobber the coordinator's checkpoint/membership/preempt timeline
-    with their own span-only rings."""
-    v = os.environ.get(ENV_JOURNAL, "").strip()
+    contract's process id — read through the jax-free knob table,
+    ops/env.py): N OS-process workers sharing one cwd must not
+    last-writer-wins clobber the coordinator's checkpoint/membership/
+    preempt timeline with their own span-only rings."""
+    v = envknob.raw(ENV_JOURNAL, "").strip()
     if v:
         return v
-    pid = os.environ.get("DL4J_TPU_PROCESS_ID", "").strip()
+    pid = envknob.raw("DL4J_TPU_PROCESS_ID", "").strip()
     suffix = f".p{pid}" if pid else ""
     return os.path.join(os.getcwd(), f".obs_journal{suffix}.jsonl")
 
@@ -83,10 +69,10 @@ class FlightRecorder:
                  flush_interval_s: Optional[float] = None):
         self.path = path or default_journal_path()
         self.capacity = (capacity if capacity is not None
-                         else max(16, _env_int(ENV_JOURNAL_N, 4096)))
+                         else max(16, envknob.get_int(ENV_JOURNAL_N, 4096)))
         self.flush_interval_s = (
             flush_interval_s if flush_interval_s is not None
-            else _env_float(ENV_FLUSH_S, 5.0))
+            else envknob.get_float(ENV_FLUSH_S, 5.0))
         self._lock = threading.Lock()
         # serializes the tmp-write+rename: concurrent flushes (a periodic
         # background flush racing the preemption fsync) share one tmp

@@ -33,11 +33,12 @@ vs a build without the tracer (tests/test_obs.py proves it).
 from __future__ import annotations
 
 import itertools
-import os
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.ops import env as envknob
 
 ENV_OBS = "DL4J_TPU_OBS"
 ENV_SPANS = "DL4J_TPU_OBS_SPANS"
@@ -55,7 +56,7 @@ def obs_enabled() -> bool:
     bench leg does exactly that)."""
     if _forced is not None:
         return _forced
-    return os.environ.get(ENV_OBS, "").strip().lower() in _ON
+    return envknob.raw(ENV_OBS, "").strip().lower() in _ON
 
 
 def set_enabled(value: Optional[bool]) -> None:
@@ -63,14 +64,6 @@ def set_enabled(value: Optional[bool]) -> None:
     decision."""
     global _forced
     _forced = value
-
-
-def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name, "").strip()
-    try:
-        return int(v) if v else default
-    except ValueError:
-        return default
 
 
 class Span:
@@ -183,7 +176,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._ring: deque = deque(
             maxlen=capacity if capacity is not None
-            else _env_int(ENV_SPANS, 4096))
+            else envknob.get_int(ENV_SPANS, 4096))
         self._local = threading.local()
         self._ids = itertools.count(1)
         self._registry = registry

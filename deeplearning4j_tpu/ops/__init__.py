@@ -6,8 +6,35 @@ Here the "backend" is jax.numpy/XLA; what remains of ND4J's surface is the
 policy (dtypes, RNG determinism) and the string-named activation registry that
 the config DSL references (reference executes activations by name through the
 op factory: deeplearning4j-core/.../nn/layers/BaseLayer.java:369-372).
+
+Re-exports are LAZY (PEP 562): ``deeplearning4j_tpu.ops.env`` — the central
+DL4J_TPU_* knob table — must stay importable without pulling jax, because the
+jax-free obs plane (obs/journal.py's "read directly to keep obs jax-free"
+rule) reads its knobs through it. An eager ``from .dispatch import ...`` here
+would drag jax into every obs import.
 """
 
-from deeplearning4j_tpu.ops.dtypes import DtypePolicy, get_policy, set_policy, float32_strict
-from deeplearning4j_tpu.ops.activations import activation, ACTIVATIONS
-from deeplearning4j_tpu.ops.dispatch import DispatchStats
+_EXPORTS = {
+    "DtypePolicy": "dtypes",
+    "get_policy": "dtypes",
+    "set_policy": "dtypes",
+    "float32_strict": "dtypes",
+    "activation": "activations",
+    "ACTIVATIONS": "activations",
+    "DispatchStats": "dispatch",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f".{mod}", __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.ops import env as envknob
 
 ENV_DONATE = "DL4J_TPU_DONATE"
 ENV_BUCKET = "DL4J_TPU_BUCKET_BATCHES"
@@ -84,7 +85,7 @@ def donation_enabled() -> bool:
     initializes the axon TPU plugin, which hangs on a dead tunnel and locks
     the platform before the caller could still choose CPU (CLAUDE.md).
     """
-    v = os.environ.get(ENV_DONATE, "").strip().lower()
+    v = envknob.raw(ENV_DONATE, "").strip().lower()
     if v in _OFF:
         return False
     if v in _ON:
@@ -114,7 +115,7 @@ def fusion_enabled(scanned_conv: bool = False) -> bool:
     ``jax_platforms`` CONFIG, never the backend (the donation-policy
     rationale: jax.default_backend() would initialize the axon plugin,
     which hangs on a dead tunnel)."""
-    v = os.environ.get(ENV_FUSE, "").strip().lower()
+    v = envknob.raw(ENV_FUSE, "").strip().lower()
     if v in _ON:  # "force" and its _ON siblings ("1"/"on"/...) all pin fusion
         return True
     if v in _OFF:
@@ -305,7 +306,7 @@ def bucketing_mode() -> str:
                  padding legitimately reassociates float32 reductions and
                  reshapes dropout draws.
     """
-    v = os.environ.get(ENV_BUCKET, "").strip().lower()
+    v = envknob.raw(ENV_BUCKET, "").strip().lower()
     if v in _OFF:
         return "off"
     if v in _ON:
@@ -404,7 +405,7 @@ _CACHE_WIRED: Optional[str] = None
 
 def compile_cache_dir() -> Optional[str]:
     """Resolve the cache directory from the env knobs (None = disabled)."""
-    v = os.environ.get(ENV_CACHE, "").strip()
+    v = envknob.raw(ENV_CACHE, "").strip()
     if v.lower() in _OFF:
         return None
     if v:
@@ -430,7 +431,7 @@ def enable_compile_cache(cache_dir: Optional[str] = None,
     Returns the active directory, or None when disabled/unsupported."""
     global _CACHE_WIRED
     with _CACHE_LOCK:
-        if os.environ.get(ENV_CACHE, "").strip().lower() in _OFF:
+        if envknob.raw(ENV_CACHE, "").strip().lower() in _OFF:
             return None  # the off-switch beats even an explicit cache_dir
         d = cache_dir or compile_cache_dir()
         if d is None:

@@ -1,0 +1,286 @@
+"""Central ``DL4J_TPU_*`` env-knob registry — the one table every knob read
+goes through.
+
+The reference concentrated its runtime configuration in one typed surface
+(``NeuralNetConfiguration`` + the ``Builder`` DSL,
+deeplearning4j-nn/.../conf/NeuralNetConfiguration.java) precisely so a typo'd
+setting failed loudly instead of silently meaning "default". Our env knobs
+grew the opposite way: ~40 ``os.environ.get("DL4J_TPU_...")`` reads scattered
+over serving/etl/resilience/obs/ops, each with its own duplicated
+``_env_int``/``_env_float`` helper and nothing catching a misspelled name.
+This module is the typed surface for them: every knob is registered here with
+its name, raw default, parser kind and one-line doc, and the graftlint
+``env-knob-registry`` rule (analysis/rules_env.py) mechanically enforces that
+
+  * no module outside this one reads a ``DL4J_TPU_*`` var from ``os.environ``
+    directly,
+  * every ``DL4J_TPU_*`` string literal anywhere in the tree names a
+    registered knob (typos fail the gate), and
+  * every registered knob is documented in CLAUDE.md.
+
+Import-weight contract: this module must stay importable WITHOUT jax — the
+obs plane is deliberately jax-free (obs/journal.py) and reads its knobs here;
+``ops/__init__`` is lazy (PEP 562) for the same reason.
+
+Semantics contract: reads are DYNAMIC (``os.environ`` at call time, never
+cached) because tests and bench legs flip knobs mid-process, and parse
+failures fall back to the default rather than raising — a garbled knob must
+not take down a training run, matching the pre-table ``_env_*`` helpers.
+Tri-state policy knobs (donate/fuse/bucket) keep their site-local parsing
+over :func:`raw`; the table owns the NAME and the documented default, not
+every consumer's enum logic.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "Knob", "KNOBS", "KnobError", "knob", "knob_names", "is_registered",
+    "raw", "get_str", "get_int", "get_float", "get_bool", "nonempty",
+]
+
+
+class KnobError(KeyError):
+    """Read of an unregistered DL4J_TPU_* name — almost always a typo."""
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str          # raw default, as the env string; "" = unset
+    kind: str             # int | float | bool | flag | str | path | enum
+    doc: str
+    choices: Tuple[str, ...] = ()
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def _register(name: str, default: str, kind: str, doc: str,
+              choices: Tuple[str, ...] = ()) -> None:
+    KNOBS[name] = Knob(name, default, kind, doc, choices)
+
+
+# ---------------------------------------------------------------------------
+# the table — grouped by plane; keep each doc line greppable next to the
+# CLAUDE.md entry the consistency gate checks for
+# ---------------------------------------------------------------------------
+
+# dispatch efficiency (ops/dispatch.py)
+_register("DL4J_TPU_DONATE", "", "enum",
+          "buffer donation for train-step jits: '' auto (on for "
+          "accelerators, off on CPU), 0 never, force always",
+          choices=("", "0", "1", "force"))
+_register("DL4J_TPU_BUCKET_BATCHES", "", "enum",
+          "shape bucketing for ragged batches: '' auto (fit_iterator/"
+          "output only), 1 every fit, 0 off",
+          choices=("", "0", "1", "auto"))
+_register("DL4J_TPU_COMPILE_CACHE", "", "path",
+          "persistent XLA compile-cache dir; '' = .jax_cache/ under cwd, "
+          "0 disables; an explicit JAX_COMPILATION_CACHE_DIR wins")
+_register("DL4J_TPU_FUSE", "", "enum",
+          "fit_batches scan fusion: '' auto (per-step fallback for "
+          "scanned-conv on XLA:CPU), force always, 0 never",
+          choices=("", "0", "1", "force"))
+
+# HBM-lean training (ops/remat.py + ops/memory.py)
+_register("DL4J_TPU_REMAT", "", "enum",
+          "activation-remat policy ladder for block scans and per-layer "
+          "remat: none (default) / dots / block",
+          choices=("", "none", "dots", "block"))
+_register("DL4J_TPU_HBM_GB", "16", "float",
+          "per-chip HBM budget (GB) the transformer preflight/auto-fit "
+          "sizers fit against")
+_register("DL4J_TPU_MEM_MEASURE_ELEMS", "2000000", "int",
+          "batch*seq*d_model element ceiling under which measure_memory "
+          "AOT-compiles on the CPU substrate for measured bytes")
+
+# precision + pallas kernel gate (ops/)
+_register("DL4J_TPU_STRICT_CONV", "", "enum",
+          "3pass forces the three-pass bf16-split strict conv everywhere "
+          "(equivalence harness)", choices=("", "3pass"))
+_register("DL4J_TPU_PALLAS", "", "enum",
+          "pallas LSTM kernel gate: '' auto (TPU only, measured-win "
+          "table), 0 off, force on even off-TPU (interpret-mode tests)",
+          choices=("", "0", "false", "False", "force"))
+_register("DL4J_TPU_PALLAS_FORCE", "", "flag",
+          "1 bypasses the PALLAS_BENCH.json measured-win gate (bench legs "
+          "measuring the kernel itself)")
+
+# observability (obs/)
+_register("DL4J_TPU_OBS", "0", "bool",
+          "span tracer master switch (default OFF; obs off => training "
+          "bit-exact)")
+_register("DL4J_TPU_OBS_SPANS", "4096", "int",
+          "span ring capacity per tracer")
+_register("DL4J_TPU_OBS_JOURNAL", "", "path",
+          "flight-recorder JSONL path; '' = .obs_journal[.pN].jsonl under "
+          "cwd (N = fleet/multihost process id)")
+_register("DL4J_TPU_OBS_JOURNAL_N", "4096", "int",
+          "flight-recorder event-ring cap")
+_register("DL4J_TPU_OBS_FLUSH_S", "5", "float",
+          "flight-recorder periodic flush interval (seconds)")
+_register("DL4J_TPU_OBS_PORT", "0", "int",
+          "standalone MetricsExporter HTTP port (0 = ephemeral)")
+
+# serving engine (serving/)
+_register("DL4J_TPU_SERVE_MAX_BATCH", "64", "int",
+          "dynamic-batcher max rows per dispatched batch")
+_register("DL4J_TPU_SERVE_MAX_WAIT_MS", "10", "float",
+          "dynamic-batcher admission window (ms)")
+_register("DL4J_TPU_SERVE_QUEUE_CAP", "512", "int",
+          "request queue cap; past it /predict answers 429")
+_register("DL4J_TPU_SERVE_TIMEOUT_S", "60", "float",
+          "per-request deadline; past it /predict answers 504")
+_register("DL4J_TPU_SERVE_SLOTS", "4", "int",
+          "continuous-batching KV slot-pool size for /generate")
+_register("DL4J_TPU_SERVE_BATCH", "", "bool",
+          "0 = naive per-request baseline instead of dynamic batching")
+_register("DL4J_TPU_SERVE_CONTINUOUS", "", "bool",
+          "0 = disable continuous-batching decode for /generate")
+_register("DL4J_TPU_SERVE_BREAKER_FAILS", "5", "int",
+          "consecutive inference failures that open a model's circuit "
+          "breaker (0 disables)")
+_register("DL4J_TPU_SERVE_WATCHDOG_S", "30", "float",
+          "hung-inference watchdog wall deadline per dispatch (0 "
+          "disables)")
+_register("DL4J_TPU_SERVE_DRAIN_S", "20", "float",
+          "graceful-drain deadline on stop()/SIGTERM")
+
+# resilience / checkpointing (resilience/)
+_register("DL4J_TPU_CKPT_EVERY", "0", "int",
+          "checkpoint every N steps (0 = off)")
+_register("DL4J_TPU_CKPT_KEEP", "3", "int",
+          "keep-last-k checkpoints")
+_register("DL4J_TPU_CKPT_ASYNC", "1", "bool",
+          "0 = synchronous checkpoint writes")
+
+# ETL / input pipeline (etl/, datasets/)
+_register("DL4J_TPU_PIPELINE_WORKERS", "0", "int",
+          "InputPipeline worker threads (0 = off; >0 also opts "
+          "fit_iterator into auto-wrapping plain iterators)")
+_register("DL4J_TPU_PREFETCH", "2", "int",
+          "staged-batch queue depth (shared with AsyncDataSetIterator)")
+_register("DL4J_TPU_DATA_DIR", "", "path",
+          "dataset cache dir; '' = ~/.deeplearning4j_tpu")
+_register("DL4J_TPU_OFFLINE", "", "flag",
+          "any non-empty value skips dataset downloads (synthetic "
+          "fallbacks engage immediately)")
+
+# multihost / fleet (parallel/)
+_register("DL4J_TPU_COORDINATOR", "", "str",
+          "jax.distributed coordinator address (host:port); unset = "
+          "single-process")
+_register("DL4J_TPU_NUM_PROCESSES", "", "int",
+          "jax.distributed process count")
+_register("DL4J_TPU_PROCESS_ID", "", "int",
+          "this process's jax.distributed / fleet rank; also suffixes the "
+          "default obs journal path")
+_register("DL4J_TPU_FLEET_HEARTBEAT_S", "5.0", "float",
+          "elastic-fleet failure-detection heartbeat timeout (seconds)")
+_register("DL4J_TPU_FLEET_MIN_WORKERS", "1", "int",
+          "elastic-fleet round blocks below this live-membership size")
+_register("DL4J_TPU_FLEET_DIR", "", "path",
+          "default fleet spool/file-membership transport dir")
+
+# bench / examples harness (bench.py, examples/)
+_register("DL4J_TPU_EXAMPLE_SMOKE", "", "flag",
+          "any non-empty value shrinks every examples/*.py to smoke-tier "
+          "shapes (the -m examples tier sets it)")
+_register("DL4J_TPU_FORCE_CPU", "", "flag",
+          "any non-empty value pins bench.py to the CPU substrate "
+          "(honest fallback legs when the tunnel is down)")
+_register("DL4J_TPU_W2V_CORPUS", "", "path",
+          "real-text corpus for the word2vec bench leg ('' = synthetic, "
+          "provenance-labelled)")
+_register("DL4J_TPU_XPLANE_TRACE", "", "path",
+          "per-leg xplane trace output dir (bench.py --trace)")
+
+
+# ---------------------------------------------------------------------------
+# readers — dynamic, registered-name-checked, default-on-garbage
+# ---------------------------------------------------------------------------
+
+
+def knob(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KnobError(
+            f"{name} is not a registered DL4J_TPU knob — add it to "
+            "deeplearning4j_tpu/ops/env.py (and CLAUDE.md) or fix the "
+            "typo") from None
+
+
+def knob_names() -> Tuple[str, ...]:
+    return tuple(sorted(KNOBS))
+
+
+def is_registered(name: str) -> bool:
+    return name in KNOBS
+
+
+def raw(name: str, default: Optional[str] = None) -> str:
+    """The raw env string, '' when unset and no default is given.
+
+    ``default`` (when provided) overrides the table default — call sites
+    with context-dependent fallbacks (e.g. CheckpointManager's explicit
+    constructor args) pass their own."""
+    k = knob(name)
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default if default is not None else k.default
+    return v
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    v = raw(name, "" if default is None else default)
+    return v if v != "" else default
+
+
+def get_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    v = raw(name, "").strip()
+    if v == "" and default is None:
+        v = knob(name).default
+    try:
+        return int(v) if v != "" else default
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    v = raw(name, "").strip()
+    if v == "" and default is None:
+        v = knob(name).default
+    try:
+        return float(v) if v != "" else default
+    except ValueError:
+        return default
+
+
+_FALSY = ("0", "off", "false", "no")
+
+
+def get_bool(name: str, default: Optional[bool] = None) -> bool:
+    """The repo's bool convention: '0'/'off'/'false'/'no' => False, any
+    other non-empty value => True, unset/empty => the table default (or
+    the ``default`` override)."""
+    v = raw(name, "").strip().lower()
+    if v == "":
+        if default is not None:
+            return default
+        v = knob(name).default.strip().lower()
+        if v == "":
+            return False
+    return v not in _FALSY
+
+
+def nonempty(name: str) -> bool:
+    """``bool(os.environ.get(name))`` parity for flag knobs (OFFLINE,
+    EXAMPLE_SMOKE, FORCE_CPU) — any non-empty value, '0' included, is
+    truthy; kept for behavior-identical migration of those sites."""
+    knob(name)
+    return bool(os.environ.get(name))

@@ -17,6 +17,8 @@ import os
 import threading
 from typing import Optional
 
+from deeplearning4j_tpu.ops import env as envknob
+
 _ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "..", "..", "PALLAS_BENCH.json")
 _lock = threading.Lock()
@@ -48,7 +50,7 @@ def measured_win(group: str, name: str, *, min_speedup: float = 1.0,
     min_speedup on a real chip. `default` is the answer when no row exists
     (fresh clone / chip never reachable): new kernels ship default-OFF
     until the artifact proves them."""
-    if os.environ.get("DL4J_TPU_PALLAS_FORCE") == "1":
+    if envknob.raw("DL4J_TPU_PALLAS_FORCE") == "1":
         return True
     row = _load().get(group, {}).get(name)
     if not isinstance(row, dict) or "speedup" not in row:

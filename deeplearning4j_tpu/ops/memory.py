@@ -39,10 +39,11 @@ guard must be computable offline.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+from deeplearning4j_tpu.ops import env as envknob
 
 ENV_HBM = "DL4J_TPU_HBM_GB"
 # configs whose batch*seq*d_model element count is at or under this are
@@ -56,7 +57,7 @@ def hbm_budget_gb(default: float = 16.0) -> float:
     """The per-chip HBM budget the sizers fit against (env-overridable —
     BENCH_NOTES records this chip's usable HBM as ~16GB)."""
     try:
-        return float(os.environ.get(ENV_HBM, "") or default)
+        return float(envknob.raw(ENV_HBM, "") or default)
     except ValueError:
         return default
 
@@ -241,7 +242,7 @@ def transformer_preflight(cfg, batch: int, *, accum_steps: int = 1,
         "estimate": "analytic",
     }
 
-    limit = int(os.environ.get(ENV_MEASURE_ELEMS, "")
+    limit = int(envknob.raw(ENV_MEASURE_ELEMS, "")
                 or _MEASURE_ELEMS_DEFAULT)
     do_measure = (measure_aot if measure_aot is not None
                   else (_cpu_substrate() and batch * seq * cfg.d_model

@@ -38,13 +38,14 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops import env as envknob
 
 # VMEM is ~16MB/core; keep a conservative budget for U + h + c + one xproj
 # block + one output block (floats).
@@ -76,7 +77,7 @@ def pallas_enabled() -> bool:
     (compiling the TPU kernel on CPU/GPU fails)."""
     if _DISABLE_OVERRIDE:
         return False
-    env = os.environ.get("DL4J_TPU_PALLAS")
+    env = envknob.raw("DL4J_TPU_PALLAS")
     if env in ("0", "false", "False"):
         return False
     if env == "force":
@@ -114,7 +115,7 @@ def lstm_kernel_wins(n: int, h: int, t: int = 32) -> bool:
     the kernel OFF for their class; no rows at all (fresh clone) keeps it
     OFF until benchmarks/pallas_lstm_bench.py runs on a chip. VMEM fit
     (lstm_scan_fits) stays a separate NECESSARY condition."""
-    if os.environ.get("DL4J_TPU_PALLAS_FORCE") == "1":
+    if envknob.raw("DL4J_TPU_PALLAS_FORCE") == "1":
         return True
     import math
 

@@ -27,8 +27,9 @@ numerics (accumulation order), not decomposition error.
 from __future__ import annotations
 
 import contextlib
-import os
 from functools import partial
+
+from deeplearning4j_tpu.ops import env as envknob
 
 import jax.numpy as jnp
 from jax import lax
@@ -51,7 +52,7 @@ def strict_conv_3pass():
 
 def strict_conv_active() -> bool:
     return _STRICT_CONV > 0 or (
-        os.environ.get("DL4J_TPU_STRICT_CONV") == "3pass")
+        envknob.raw("DL4J_TPU_STRICT_CONV") == "3pass")
 
 
 def _split_bf16(a):

@@ -43,8 +43,9 @@ now unified under the same knob). Measured evidence lives in the
 
 from __future__ import annotations
 
-import os
 from typing import Optional
+
+from deeplearning4j_tpu.ops import env as envknob
 
 ENV_REMAT = "DL4J_TPU_REMAT"
 
@@ -62,7 +63,7 @@ def remat_policy(configured: Optional[str] = "auto") -> str:
     tunnel contact (the exact failure the ladder exists to prevent)."""
     v = (configured or "auto").strip().lower()
     if v == "auto":
-        v = os.environ.get(ENV_REMAT, "").strip().lower() or "none"
+        v = envknob.raw(ENV_REMAT, "").strip().lower() or "none"
     if v not in POLICIES:
         raise ValueError(
             f"unknown remat policy {v!r} (known: {', '.join(POLICIES)}, "

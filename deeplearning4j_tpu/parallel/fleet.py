@@ -74,6 +74,7 @@ import numpy as np
 from deeplearning4j_tpu.obs import journal as obs_journal
 from deeplearning4j_tpu.obs import registry as obs_registry
 from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.ops import env as envknob
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -85,19 +86,11 @@ _MANIFEST = "fleet"  # FileServiceRegistry entry for cross-process workers
 
 
 def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name)
-    try:
-        return float(v) if v not in (None, "") else default
-    except ValueError:
-        return default
+    return envknob.get_float(name, default)
 
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    try:
-        return int(v) if v not in (None, "") else default
-    except ValueError:
-        return default
+    return envknob.get_int(name, default)
 
 
 def shard_for(worker_id: str, live: List[str]) -> Optional[Tuple[int, int]]:
@@ -575,7 +568,7 @@ class ElasticParameterAveragingTrainer:
             max_attempts=job_max_attempts)
         self.membership_board = membership_board
         self.chaos = chaos
-        self.spool_dir = spool_dir or os.environ.get(FLEET_DIR_ENV)
+        self.spool_dir = spool_dir or envknob.get_str(FLEET_DIR_ENV)
         self.round_timeout_s = float(round_timeout_s)
         self.round_index = 0  # 1-based during a round; 0 before the first
         self.resilience_stats: Dict[str, Any] = {

@@ -19,6 +19,8 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
+from deeplearning4j_tpu.ops import env as envknob
+
 
 # THE env-var contract between launchers (provision/tpu_pod.py bootstrap)
 # and this runtime — both sides import these names, so they cannot drift
@@ -40,7 +42,7 @@ class MultiHostConfig:
     @classmethod
     def from_env(cls) -> "MultiHostConfig":
         return cls(
-            coordinator_address=os.environ.get(COORDINATOR_ENV),
+            coordinator_address=envknob.get_str(COORDINATOR_ENV),
             num_processes=_int_env(NUM_PROCESSES_ENV),
             process_id=_int_env(PROCESS_ID_ENV),
         )
@@ -50,8 +52,7 @@ class MultiHostConfig:
 
 
 def _int_env(name: str) -> Optional[int]:
-    v = os.environ.get(name)
-    return int(v) if v is not None else None
+    return envknob.get_int(name)
 
 
 _initialized = False

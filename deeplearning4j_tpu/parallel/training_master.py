@@ -198,9 +198,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         max_retries: int = 2,
         rng_seed: int = 12345,
     ):
-        import jax
-
-        self.num_workers = num_workers or len(jax.devices())
+        # worker count defaults to the device count, resolved LAZILY at
+        # first use (the num_workers property): len(jax.devices()) here
+        # would initialize the axon TPU plugin at construction time and
+        # hang forever on a dead tunnel (the CLAUDE.md stale-tunnel rule)
+        # even for a master that is only being configured/serialized
+        self._num_workers = int(num_workers) if num_workers else None
         self.batch_size_per_worker = batch_size_per_worker
         self.averaging_frequency = max(1, averaging_frequency)
         self.save_updater = save_updater
@@ -212,6 +215,18 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self._trainer: Optional[ParameterAveragingTrainer] = None
         self._trainer_net = None
         self._round = 0
+
+    @property
+    def num_workers(self) -> int:
+        if self._num_workers is None:
+            import jax
+
+            self._num_workers = len(jax.devices())
+        return self._num_workers
+
+    @num_workers.setter
+    def num_workers(self, value: int) -> None:
+        self._num_workers = int(value)
 
     # -- data plane helpers -----------------------------------------------
     def _examples_per_split(self) -> int:
@@ -416,9 +431,21 @@ class DistributedEvaluator:
     EvaluationReduceFunction): evaluate shards independently, merge."""
 
     def __init__(self, num_shards: Optional[int] = None):
-        import jax
+        # same lazy rule as ParameterAveragingTrainingMaster.num_workers:
+        # never touch jax.devices() before work actually arrives
+        self._num_shards = int(num_shards) if num_shards else None
 
-        self.num_shards = num_shards or len(jax.devices())
+    @property
+    def num_shards(self) -> int:
+        if self._num_shards is None:
+            import jax
+
+            self._num_shards = len(jax.devices())
+        return self._num_shards
+
+    @num_shards.setter
+    def num_shards(self, value: int) -> None:
+        self._num_shards = int(value)
 
     def evaluate(self, net, datasets: Iterable[DataSet]) -> Evaluation:
         datasets = list(datasets)

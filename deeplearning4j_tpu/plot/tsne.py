@@ -62,6 +62,7 @@ def _binary_search_betas(d2: np.ndarray, perplexity: float, tol: float = 1e-5,
     return P / np.maximum(P.sum(axis=1, keepdims=True), 1e-12)
 
 
+# graftlint: disable=donation-through-dispatch -- functional-update idiom predating ops/dispatch: every caller rebinds to the returned tables and never re-reads the donated args (the no-re-read contract is structural at each call site)
 @functools.partial(jax.jit, donate_argnums=(1, 2, 3))
 def _tsne_step(P, Y, velocity, gains, momentum, lr):
     """One exact t-SNE gradient step with momentum + gains (Tsne.java

@@ -54,6 +54,7 @@ import numpy as np
 
 from deeplearning4j_tpu.obs import journal as obs_journal
 from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.ops import env as envknob
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -121,11 +122,7 @@ def _host_tree(tree):
 
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    try:
-        return int(v) if v not in (None, "") else default
-    except ValueError:
-        return default
+    return envknob.get_int(name, default)
 
 
 @dataclass
@@ -169,7 +166,7 @@ class CheckpointManager:
         self.keep_last = (_env_int(ENV_KEEP, 3) if keep_last is None
                           else int(keep_last))
         self.keep_every = keep_every
-        self.async_save = (os.environ.get(ENV_ASYNC, "1") != "0"
+        self.async_save = (envknob.raw(ENV_ASYNC, "1") != "0"
                            if async_save is None else bool(async_save))
         self.backend = backend
         self.compression = compression

@@ -71,6 +71,7 @@ from deeplearning4j_tpu.obs import journal as obs_journal
 from deeplearning4j_tpu.obs import registry as obs_registry
 from deeplearning4j_tpu.obs import trace as obs_trace
 from deeplearning4j_tpu.obs.exporter import PROMETHEUS_CONTENT_TYPE
+from deeplearning4j_tpu.ops import env as envknob
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher,
     QueueFullError,
@@ -119,10 +120,10 @@ class ServingEngine:
         self.slots = int(slots if slots is not None
                          else _env_float("DL4J_TPU_SERVE_SLOTS", 4))
         self.batching_enabled = (
-            os.environ.get("DL4J_TPU_SERVE_BATCH", "").strip().lower()
+            envknob.raw("DL4J_TPU_SERVE_BATCH", "").strip().lower()
             not in ("0", "off", "false", "no"))
         self.continuous_enabled = (
-            os.environ.get("DL4J_TPU_SERVE_CONTINUOUS", "").strip().lower()
+            envknob.raw("DL4J_TPU_SERVE_CONTINUOUS", "").strip().lower()
             not in ("0", "off", "false", "no"))
         self.stats = ServingStats()
         # the serving ledger joins the central MetricsRegistry (ISSUE 7):
@@ -281,6 +282,7 @@ class ServingEngine:
         import jax.numpy as jnp
 
         with self._lock:
+            # graftlint: disable=host-sync-under-lock -- host->device staging of the request tokens, not a readback; the lock deliberately serializes whole generate() calls (single-model contract)
             out = model.generate(jnp.asarray(tokens, jnp.int32), int(n_new),
                                  temperature=float(temperature),
                                  seed=int(seed), top_k=top_k, top_p=top_p)

@@ -65,11 +65,12 @@ resilience/chaos.ServingChaosConfig.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, Optional
+
+from deeplearning4j_tpu.ops import env as envknob
 
 ENV_BREAKER_FAILS = "DL4J_TPU_SERVE_BREAKER_FAILS"
 ENV_WATCHDOG_S = "DL4J_TPU_SERVE_WATCHDOG_S"
@@ -82,11 +83,7 @@ BROKEN = "broken"
 
 
 def _env_float(name: str, default: float) -> float:
-    v = os.environ.get(name, "").strip()
-    try:
-        return float(v) if v else default
-    except ValueError:
-        return default
+    return envknob.get_float(name, default)
 
 
 def breaker_fails_default() -> int:

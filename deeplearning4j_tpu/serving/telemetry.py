@@ -161,6 +161,7 @@ class ServingStats:
     def latency_ms(self) -> Dict[str, Optional[float]]:
         """p50/p95/p99 of the recent-latency ring, in milliseconds."""
         with self._lock:
+            # graftlint: disable=host-sync-under-lock -- self._lat is a host-side deque of floats; no device buffer ever enters this ring
             lat = np.asarray(self._lat, np.float64)
         if lat.size == 0:
             return {"p50": None, "p95": None, "p99": None, "count": 0}

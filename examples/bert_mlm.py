@@ -23,6 +23,7 @@ import numpy as np  # noqa: E402
 from deeplearning4j_tpu.models.bert import BertConfig, BertMLM  # noqa: E402
 from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory  # noqa: E402
 from deeplearning4j_tpu.nlp.vocab import VocabCache  # noqa: E402
+from deeplearning4j_tpu.ops import env as envknob
 
 SEQ_LEN = 12
 PAD, MASK = "[PAD]", "[MASK]"
@@ -32,7 +33,7 @@ VERBS = ["sat on", "ran past", "looked at", "slept under"]
 OBJECTS = ["the mat", "a tree", "the fence", "one rock"]
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def corpus(n: int, rng) -> list:

@@ -19,6 +19,7 @@ import numpy as np
 
 from deeplearning4j_tpu.models.char_rnn import char_rnn_conf
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops import env as envknob
 
 CORPUS = (
     "the quick brown fox jumps over the lazy dog. "
@@ -27,7 +28,7 @@ CORPUS = (
 ) * 40
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

@@ -22,10 +22,11 @@ from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: E402
     ParallelWrapper,
     ParameterAveragingTrainer,
 )
+from deeplearning4j_tpu.ops import env as envknob
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

@@ -30,10 +30,11 @@ from deeplearning4j_tpu.nn.conf import (
     OutputLayer,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops import env as envknob
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

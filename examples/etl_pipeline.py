@@ -53,9 +53,10 @@ from deeplearning4j_tpu.utils.serialization import (  # noqa: E402
     ModelSerializer,
     read_normalizer,
 )
+from deeplearning4j_tpu.ops import env as envknob
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 ROWS = 400 if SMOKE else 4000
 BATCH = 32

@@ -16,10 +16,11 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 from deeplearning4j_tpu.models.googlenet import build_googlenet  # noqa: E402
+from deeplearning4j_tpu.ops import env as envknob
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

@@ -27,10 +27,11 @@ from deeplearning4j_tpu.models.transformer import (  # noqa: E402
     init_params,
     ring_forward,
 )
+from deeplearning4j_tpu.ops import env as envknob
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 SEQ = 128 if SMOKE else 512
 
 

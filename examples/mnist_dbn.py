@@ -19,10 +19,11 @@ import numpy as np
 from deeplearning4j_tpu.datasets.fetchers import load_mnist_info
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.models.dbn import build_dbn
+from deeplearning4j_tpu.ops import env as envknob
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

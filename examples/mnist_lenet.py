@@ -22,12 +22,13 @@ from deeplearning4j_tpu.datasets.fetchers import load_mnist_info
 from deeplearning4j_tpu.eval.evaluation import Evaluation
 from deeplearning4j_tpu.models.lenet import build_lenet5
 from deeplearning4j_tpu.utils.serialization import ModelSerializer
+from deeplearning4j_tpu.ops import env as envknob
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py):
 # the stock flow unchanged, just fewer examples/epochs so 11 entrypoints
 # finish in minutes on the 1-core CPU host
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

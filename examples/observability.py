@@ -48,9 +48,10 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: E402
     DispatchStatsListener,
 )
+from deeplearning4j_tpu.ops import env as envknob
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 N_EXAMPLES = 128 if SMOKE else 1024
 HIDDEN = 16 if SMOKE else 128

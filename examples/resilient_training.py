@@ -45,9 +45,10 @@ from deeplearning4j_tpu.resilience import (  # noqa: E402
     InjectedKill,
     ResilientTrainer,
 )
+from deeplearning4j_tpu.ops import env as envknob
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 N_EXAMPLES = 128 if SMOKE else 512
 HIDDEN = 16 if SMOKE else 64

@@ -22,12 +22,13 @@ from deeplearning4j_tpu.models.transformer import (  # noqa: E402
     TransformerLM,
 )
 from deeplearning4j_tpu.parallel.mesh import device_mesh  # noqa: E402
+from deeplearning4j_tpu.ops import env as envknob
 
 TEXT = ("to be or not to be that is the question "
         "whether tis nobler in the mind to suffer ") * 60
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():
@@ -38,7 +39,7 @@ def main():
     # activation-remat knob (ops/remat.py ladder): DL4J_TPU_REMAT picks
     # none/dots/block; the `-m examples` smoke tier pins "block" so the
     # remat path is exercised end-to-end on every smoke run
-    remat = os.environ.get("DL4J_TPU_REMAT") or ("block" if SMOKE else "auto")
+    remat = envknob.raw("DL4J_TPU_REMAT") or ("block" if SMOKE else "auto")
     cfg = TransformerConfig(vocab_size=len(chars), d_model=64, n_layers=2,
                             n_heads=4, d_ff=128, max_len=64,
                             learning_rate=3e-3, remat=remat)

@@ -15,6 +15,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.ops import env as envknob
 
 SENTENCES = [
     "the king rules the kingdom with the queen",
@@ -30,7 +31,7 @@ SENTENCES = [
 
 
 # tiny-shape mode for the `-m examples` smoke tier (tests/test_examples.py)
-SMOKE = bool(os.environ.get("DL4J_TPU_EXAMPLE_SMOKE"))
+SMOKE = envknob.nonempty("DL4J_TPU_EXAMPLE_SMOKE")
 
 
 def main():

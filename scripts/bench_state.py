@@ -56,9 +56,13 @@ def expected_legs() -> list:
         return EXPECTED
 
 
-def legs_of(path: str) -> dict:
+def load_artifact(path: str) -> dict:
     with open(path) as f:
-        data = json.load(f)
+        return json.load(f)
+
+
+def legs_of(path: str) -> dict:
+    data = load_artifact(path)
     return data.get("legs") or data.get("extras") or {}
 
 
@@ -115,11 +119,19 @@ def warnings(legs: dict) -> list:
 def main() -> int:
     path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_PARTIAL.json"
     try:
-        legs = legs_of(path)
+        data = load_artifact(path)
+        legs = data.get("legs") or data.get("extras") or {}
         missing = gaps(legs)
     except (OSError, ValueError) as e:
         print(f"unreadable {path}: {e}")
         return 1
+    # lint provenance (ISSUE 10): an artifact stamped from a graftlint-
+    # DIRTY tree is still a measurement, but a summarizer quoting it as
+    # this round's proof should say so (None = linter unavailable; no
+    # warning — absence of the bit is not evidence of dirt)
+    if data.get("graftlint_clean") is False:
+        print("WARN: artifact was produced from a graftlint-DIRTY tree "
+              "(run `python -m deeplearning4j_tpu.analysis`)")
     for w in warnings(legs):
         print("WARN:", w)
     if missing:

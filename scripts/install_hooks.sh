@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Install the opt-in pre-commit hook: graftlint must be clean before a
+# commit lands. The hook is a thin shim to scripts/lint.sh so hook
+# behavior updates with the repo, not with re-installation.
+#
+#   scripts/install_hooks.sh
+#
+# Bypass for a genuinely exceptional commit: git commit --no-verify
+# (prefer a per-site `# graftlint: disable=<rule> -- <why>` instead —
+# the suppression inventory is the documented-exceptions list).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+hook_dir=$(git rev-parse --git-path hooks)
+mkdir -p "$hook_dir"
+cat > "$hook_dir/pre-commit" <<'HOOK'
+#!/usr/bin/env bash
+# installed by scripts/install_hooks.sh — graftlint gate
+exec bash "$(git rev-parse --show-toplevel)/scripts/lint.sh"
+HOOK
+chmod +x "$hook_dir/pre-commit"
+echo "installed $hook_dir/pre-commit -> scripts/lint.sh (graftlint gate)"

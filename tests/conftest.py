@@ -84,6 +84,10 @@ _QUICK_FILES = {
     # drain/isolation contracts — deterministic injected faults on tiny
     # nets, the serving third of the crash-recovery convention
     "test_serving_resilience.py",
+    # graftlint (ISSUE 10): per-rule fixture contracts + the repo-wide
+    # clean sweep + the knob-table↔CLAUDE.md consistency gate — pure-AST,
+    # jax-free, seconds for the fixtures and ~15s for the sweep
+    "test_analysis.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
