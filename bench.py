@@ -1184,6 +1184,162 @@ def bench_serving_resilience(clients=16, per_client=8):
 
 
 # ---------------------------------------------------------------------------
+# serving_decode: paged block-pool /generate vs the fixed slot pool at
+# EQUAL KV HBM budget (ISSUE 11 — serving/paged.py). CPU-only by design:
+# the contested resource is KV capacity and the win is scheduling
+# (admission by free blocks + prefix sharing lets ~4x the streams
+# co-reside in the same bytes), which exists on every backend; the tick
+# arithmetic is the same jitted program either way.
+# ---------------------------------------------------------------------------
+
+_SERVING_DECODE_SCRIPT = r"""
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+streams, n_new = int(sys.argv[1]), int(sys.argv[2])
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+from deeplearning4j_tpu.serving.decode import ContinuousDecoder
+from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+SLOTS, BLOCK, PREFIX = 4, 16, 48
+cfg = TransformerConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+                        d_ff=128, max_len=128, use_flash=False)
+lm = TransformerLM(cfg)
+budget_tokens = SLOTS * cfg.max_len   # the fixed 4-slot pool's KV bytes,
+n_blocks = budget_tokens // BLOCK     # handed to the paged arena instead
+
+rng = np.random.default_rng(0)
+system = rng.integers(1, 64, PREFIX)  # shared system prompt: 3 full blocks
+prompts = [np.concatenate([system, rng.integers(1, 64, 8)]).astype(np.int32)
+           for _ in range(streams)]
+
+
+def pooled(make):
+    d = make()
+    try:
+        t0 = time.perf_counter()
+        futs = [d.submit(p, n_new, temperature=0.0, timeout_s=600)
+                for p in prompts]
+        outs = [np.asarray(f.result(timeout=600)) for f in futs]
+        wall = time.perf_counter() - t0
+        snap = d.stats.snapshot()
+        lat = snap["latency_ms"]
+        return outs, {
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(streams * n_new / wall, 1),
+            "concurrent_streams": d.peak_active,
+            "p50_ms": lat["p50"],
+            "p99_ms": lat["p99"],
+        }, snap
+    finally:
+        d.stop()
+
+
+make_paged = lambda: PagedDecoder(lm, block_tokens=BLOCK, n_blocks=n_blocks)
+make_fixed = lambda: ContinuousDecoder(lm, slots=SLOTS)
+
+# solo baselines (single-request path — the byte-identity reference)
+d = make_paged()
+try:
+    solo = np.asarray(d.generate(prompts[0][None], n_new,
+                                 temperature=0.0)[0])
+finally:
+    d.stop()
+d = make_fixed()
+try:
+    solo_fixed = np.asarray(d.generate(prompts[0][None], n_new,
+                                       temperature=0.0)[0])
+finally:
+    d.stop()
+assert (solo == solo_fixed).all()
+
+# warm pass: compiles the preemption path's re-admission prefill widths
+# so the timed pass measures scheduling, not XLA
+pooled(make_paged)
+
+outs_p, row_p, snap_p = pooled(make_paged)
+outs_f, row_f, snap_f = pooled(make_fixed)
+
+assert (outs_p[0] == solo).all()        # pool-independence, paged
+assert (outs_f[0] == solo_fixed).all()  # pool-independence, fixed slot
+for a, b in zip(outs_p, outs_f):
+    assert (a == b).all()               # cross-decoder identity
+
+hit_rate = (snap_p["prefix_hits"] / snap_p["prefix_lookups"]
+            if snap_p["prefix_lookups"] else None)
+
+# span evidence AFTER the timed runs (the tracer never rides the hot
+# path): serve.request (engine) parents serve.batch (paged tick)
+obs.set_enabled(True)
+eng = ServingEngine(model=lm, kv_block=BLOCK, kv_blocks=n_blocks)
+try:
+    eng.generate(prompts[0][None], 4, temperature=0.0)
+finally:
+    eng.stop()
+reqs = obs.tracer().spans("serve.request")
+batches = [s for s in obs.tracer().spans("serve.batch")
+           if s["attrs"].get("kind") == "decode.paged"]
+assert reqs and batches, "span evidence missing"
+obs.set_enabled(None)
+
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "streams": streams,
+    "n_new": n_new,
+    "shared_prefix_tokens": PREFIX,
+    "kv_budget_tokens": budget_tokens,
+    "block_tokens": BLOCK,
+    "n_blocks": n_blocks,
+    "paged": row_p,
+    "fixed_slot": row_f,
+    "stream_ratio": round(row_p["concurrent_streams"]
+                          / max(1, row_f["concurrent_streams"]), 2),
+    "stream_ratio_bar": 4.0,
+    "tokens_per_sec_ratio": round(row_p["tokens_per_sec"]
+                                  / max(1e-9, row_f["tokens_per_sec"]), 2),
+    "prefix_hit_rate": (round(hit_rate, 3) if hit_rate is not None
+                        else None),
+    "preemptions": snap_p["preemptions"],
+    "byte_identical": True,
+    "span_evidence": {"serve_request": len(reqs),
+                      "serve_batch_paged": len(batches)},
+    "stat": "one timed pass per pool over the same prompts (greedy), "
+            "after a warm pass; latency percentiles from the decoder's "
+            "own enqueue-to-completion ledger",
+    "note": "equal KV budget: the fixed pool's slots*max_len tokens "
+            "re-housed as a block arena (+1 trash block); the stream "
+            "win is admission-by-free-blocks + prefix sharing, the "
+            "byte-identity asserts are the independence contract",
+}))
+"""
+
+
+def bench_serving_decode(streams=16, n_new=24):
+    """Paged-KV decode leg (serving/paged.py): concurrent streams,
+    aggregate tokens/s, and p50/p99 latency of the block-pool /generate
+    plane vs the fixed 4-slot pool at EQUAL KV HBM budget, on a
+    shared-system-prompt workload (prefix-cache hit rate and preemption
+    count stamped). Asserts greedy outputs byte-identical to the
+    single-request path on both pools, and serve.request -> serve.batch
+    span evidence through the engine. Subprocess-isolated, CPU-only by
+    design — the win is scheduling, not arithmetic."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _SERVING_DECODE_SCRIPT, str(streams),
+         str(n_new)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
 # checkpoint_overhead: sync vs async checkpointing cost (resilience/)
 # ---------------------------------------------------------------------------
 
@@ -2247,7 +2403,8 @@ def _run_isolated(name: str, quick: bool, timeout_s: int = 0,
 # CPU-for-CPU baseline pair (forced jax-CPU by design).
 _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "native_feed", "dispatch_overhead", "serving_throughput",
-                  "serving_resilience", "checkpoint_overhead",
+                  "serving_resilience", "serving_decode",
+                  "checkpoint_overhead",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
                   "obs_overhead"}
@@ -2446,7 +2603,8 @@ def main():
                     extras[name] = fn(*a, **kw)
             elif name in ("scaling_virtual8", "north_star", "lstm_kernel",
                           "dispatch_overhead", "serving_throughput",
-                          "serving_resilience", "checkpoint_overhead",
+                          "serving_resilience", "serving_decode",
+                          "checkpoint_overhead",
                           "lenet5_cpu", "char_rnn_cpu", "remat_memory",
                           "input_pipeline", "elastic_dp", "obs_overhead"):
                 # already subprocess-isolated internally
@@ -2506,6 +2664,8 @@ def main():
     run("north_star", bench_north_star, steps=10 if quick else 100)
     run("serving_throughput", bench_serving_throughput,
         per_client=4 if quick else 16)
+    run("serving_decode", bench_serving_decode,
+        streams=16, n_new=12 if quick else 24)
     run("serving_resilience", bench_serving_resilience,
         per_client=4 if quick else 8)
     run("checkpoint_overhead", bench_checkpoint_overhead,

@@ -94,6 +94,23 @@ def donation_enabled() -> bool:
     return not (platforms and platforms.split(",")[0] == "cpu")
 
 
+def arena_jit(fn, donate: Sequence[int] = ()):
+    """jit for SINGLE-OWNER accumulator buffers — donated by default
+    even on CPU.
+
+    donation_enabled() defaults off on CPU because equivalence tests
+    hold one params tree across several step functions; that caveat does
+    not apply to a buffer with exactly one owner who always rebinds the
+    result and never re-reads the input — the paged-KV serving arena
+    (serving/paged.py), where an un-donated tick would copy the whole
+    arena per generated token. An explicit ``DL4J_TPU_DONATE=0`` still
+    wins (the knob's 'never' contract covers every donating jit)."""
+    v = envknob.raw(ENV_DONATE, "").strip().lower()
+    if v in _OFF or not donate:
+        return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=tuple(donate))
+
+
 # ---------------------------------------------------------------------------
 # fusion policy (fit_batches' scan-of-steps)
 # ---------------------------------------------------------------------------

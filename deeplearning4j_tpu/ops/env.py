@@ -149,6 +149,16 @@ _register("DL4J_TPU_SERVE_WATCHDOG_S", "30", "float",
           "disables)")
 _register("DL4J_TPU_SERVE_DRAIN_S", "20", "float",
           "graceful-drain deadline on stop()/SIGTERM")
+_register("DL4J_TPU_SERVE_KV_BLOCK", "16", "int",
+          "paged-KV block size in tokens for /generate (0 = fall back "
+          "to the fixed slot pool)")
+_register("DL4J_TPU_SERVE_KV_BLOCKS", "0", "int",
+          "paged-KV arena size in blocks (0 = auto-size from "
+          "DL4J_TPU_HBM_GB via ops/memory.kv_arena_blocks)")
+_register("DL4J_TPU_SERVE_SLO_CLASSES", "", "str",
+          "SLO scheduling classes 'name:deadline_s,...' highest "
+          "priority first ('' = one default class at the request "
+          "timeout)")
 
 # resilience / checkpointing (resilience/)
 _register("DL4J_TPU_CKPT_EVERY", "0", "int",

@@ -315,3 +315,41 @@ def auto_fit_transformer(cfg, *, batches=(32, 16, 8, 4),
                     return {"batch": b, "accum_steps": a, "remat": p,
                             "report": rep}
     return None
+
+
+# ---------------------------------------------------------------------------
+# paged-KV arena sizing (the serving-side twin of auto_fit_transformer)
+# ---------------------------------------------------------------------------
+
+
+def kv_block_bytes(cfg, block_tokens: int) -> int:
+    """Device bytes of ONE paged KV block across all layers: K and V,
+    [n_layers, block_tokens, n_heads, head_dim] each, in the model's
+    compute dtype (serving/paged.py's arena layout)."""
+    hd = cfg.d_model // cfg.n_heads
+    itemsize = np.dtype(cfg.compute_dtype).itemsize
+    return 2 * cfg.n_layers * int(block_tokens) * cfg.n_heads * hd * itemsize
+
+
+def kv_arena_blocks(cfg, block_tokens: int, *, params=None,
+                    hbm_gb: Optional[float] = None,
+                    kv_fraction: float = 0.5,
+                    max_blocks: int = 4096) -> int:
+    """How many KV blocks the arena can afford under ``DL4J_TPU_HBM_GB``.
+
+    Budget = HBM minus twice the parameter bytes (weights resident plus
+    one transient copy for dispatch headroom), times ``kv_fraction``
+    (the rest stays free for prefill temporaries and the serving
+    batcher's bucket programs), divided by :func:`kv_block_bytes`.
+    Clamped to [one max_len sequence + 1, max_blocks] so a tiny budget
+    still yields a decoder that can serve a single request and a huge
+    one doesn't balloon the tick's gather. This replaces the fixed
+    pool's ``slots * max_len`` over-allocation with sizing from the
+    accounting plane (ISSUE 11 satellite)."""
+    budget = (hbm_gb if hbm_gb is not None else hbm_budget_gb()) * 2.0**30
+    if params is not None:
+        budget -= 2.0 * _tree_bytes(params)
+    per_block = kv_block_bytes(cfg, block_tokens)
+    blocks = int(max(0.0, budget) * float(kv_fraction) / per_block)
+    floor = cfg.max_len // int(block_tokens) + 1
+    return max(floor, min(int(max_blocks), blocks))

@@ -18,6 +18,16 @@ package concentrates the counter-measures:
                 and queued prompts admitted mid-loop, so /generate
                 throughput no longer quantizes to the slowest sequence of
                 a static batch.
+  paged.py      PagedDecoder — the block-pool /generate plane (ISSUE 11):
+                one device-resident KV block arena with per-request block
+                tables gathered inside the jitted tick, admission gated
+                by free-block count, refcounted prefix caching, youngest-
+                victim preemption, per-token streaming callbacks, and
+                SLO-class scheduling (slo.py). Default via
+                DL4J_TPU_SERVE_KV_BLOCK; =0 falls back to decode.py.
+  slo.py        SLOClass/parse_slo_classes — jax-free scheduling classes
+                (per-class deadlines + priority order + shed policy) for
+                the paged admission loop.
   registry.py   ModelRegistry — named/versioned load → warmup → serve →
                 unload lifecycle (warmup pre-compiles the bucket set
                 before a model takes traffic; unload frees device
@@ -59,6 +69,7 @@ from deeplearning4j_tpu.serving.resilience import (
     ModelWedgedError,
     WorkerDeadError,
 )
+from deeplearning4j_tpu.serving.slo import SLOClass, parse_slo_classes
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
 __all__ = [
@@ -71,21 +82,28 @@ __all__ = [
     "InferenceWatchdog",
     "ModelRegistry",
     "ModelWedgedError",
+    "PagedDecoder",
     "QueueFullError",
     "RequestTimeoutError",
+    "SLOClass",
     "ServingEngine",
     "ServingStats",
     "WorkerDeadError",
+    "parse_slo_classes",
 ]
 
 
 def __getattr__(name):
-    # ContinuousDecoder resolves lazily (PEP 562): it pulls the whole
-    # models/transformer stack, which non-LM servers (and the bench's
-    # serving subprocess) never need — engine.py defers the same import
-    # into _decoder_for for the same reason.
+    # ContinuousDecoder/PagedDecoder resolve lazily (PEP 562): they pull
+    # the whole models/transformer stack, which non-LM servers (and the
+    # bench's serving subprocess) never need — engine.py defers the same
+    # import into _decoder_for for the same reason.
     if name == "ContinuousDecoder":
         from deeplearning4j_tpu.serving.decode import ContinuousDecoder
 
         return ContinuousDecoder
+    if name == "PagedDecoder":
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        return PagedDecoder
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

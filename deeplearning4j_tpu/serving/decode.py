@@ -229,10 +229,28 @@ class ContinuousDecoder:
         # prompts nobody will decode
         self._chaos = chaos
         self._dead: Optional[str] = None
+        self.peak_active = 0  # high-water concurrent sequences (bench)
         self._tick = _tick_for(cfg)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="continuous-decoder")
         self._worker.start()
+
+    def kv_capacity(self) -> Dict[str, object]:
+        """/models KV report (the paged pool's richer twin lives on
+        PagedDecoder.kv_capacity): the fixed pool pre-allocates every
+        slot at max_len, so capacity is slots * max_len regardless of
+        what requests actually use — the over-allocation the paged
+        arena exists to fix."""
+        with self._cond:
+            active = [int(self._pos[i]) + 1
+                      for i, st in enumerate(self._slots) if st is not None]
+        return {
+            "scheme": "fixed-slot",
+            "slots": self.slots,
+            "capacity_tokens": self.slots * self.cfg.max_len,
+            "tokens_in_use": sum(active),
+            "lanes": self.slots,
+        }
 
     # -- client side ------------------------------------------------------
     def submit(self, prompt, n_new: int, temperature: float = 1.0,
@@ -404,6 +422,7 @@ class ContinuousDecoder:
                 self.stats.set_queue_depth(len(self._pending), "decode")
                 active = [i for i in range(self.slots)
                           if self._slots[i] is not None]
+                self.peak_active = max(self.peak_active, len(active))
                 if not active:
                     if not self._running:
                         return

@@ -12,10 +12,15 @@ wire surface (streaming/serving.ModelServer subclasses this unchanged):
                   429 when the batcher queue is full (backpressure),
                   504 when the request's deadline expires in queue.
   POST /generate  {"tokens": [[ids]], "n_new": K, "temperature"?,
-                  "top_k"?, "top_p"?, "seed"?} -> {"tokens": [[ids]]}
-                  (continuous-batching slot pool when the model supports
-                  it and no static filter is requested; lm.generate
-                  otherwise)
+                  "top_k"?, "top_p"?, "seed"?, "slo"?} -> {"tokens":
+                  [[ids]]} (paged block-pool decode by default —
+                  serving/paged.py; the fixed slot pool when
+                  DL4J_TPU_SERVE_KV_BLOCK=0; lm.generate for static
+                  filters / mesh / MoE models). With "stream": true the
+                  response is chunked application/x-ndjson: one
+                  {"token": t} line per generated token as it is
+                  sampled, then {"done": true, "tokens": [...]} (or
+                  {"error": ...} if generation failed mid-stream).
   GET  /health    {"ok": true, "model": "<type>", "models": [...]}
   GET  /metrics   {"serving": <ServingStats>, "models": [<per-model
                   state incl. dispatch_stats>]}
@@ -29,8 +34,17 @@ Env knobs (read at engine construction):
   DL4J_TPU_SERVE_MAX_WAIT_MS batcher deadline flush (default 10)
   DL4J_TPU_SERVE_QUEUE_CAP   queued rows before 429 (default 512)
   DL4J_TPU_SERVE_TIMEOUT_S   default per-request deadline (default 60)
-  DL4J_TPU_SERVE_SLOTS       continuous-decode slot pool size (default 4)
+  DL4J_TPU_SERVE_SLOTS       continuous-decode slot pool size (default 4;
+                             the paged pool reuses it as its lane FLOOR)
   DL4J_TPU_SERVE_CONTINUOUS  "0" routes /generate to lm.generate always
+  DL4J_TPU_SERVE_KV_BLOCK    paged-KV block size in tokens (default 16;
+                             "0" falls back to the fixed slot pool)
+  DL4J_TPU_SERVE_KV_BLOCKS   paged-KV arena size in blocks (default 0 =
+                             auto-size from DL4J_TPU_HBM_GB via
+                             ops/memory.kv_arena_blocks)
+  DL4J_TPU_SERVE_SLO_CLASSES scheduling classes "name:deadline_s,..."
+                             highest priority first ("" = one default
+                             class at the request timeout — pre-SLO FIFO)
 
 Resilience plane (ISSUE 8 — serving/resilience.py):
   DL4J_TPU_SERVE_BREAKER_FAILS consecutive inference failures that open a
@@ -58,6 +72,7 @@ import itertools
 import json
 import math
 import os
+import queue as stdqueue
 import signal
 import threading
 import time
@@ -90,6 +105,7 @@ from deeplearning4j_tpu.serving.resilience import (
     drain_s_default,
     watchdog_s_default,
 )
+from deeplearning4j_tpu.serving.slo import parse_slo_classes
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
 
@@ -101,6 +117,9 @@ class ServingEngine:
                  queue_capacity: Optional[int] = None,
                  request_timeout_s: Optional[float] = None,
                  slots: Optional[int] = None,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 slo_classes: Optional[str] = None,
                  breaker_fails: Optional[int] = None,
                  breaker_cooldown_s: float = 2.0,
                  watchdog_s: Optional[float] = None,
@@ -119,6 +138,15 @@ class ServingEngine:
             else _env_float("DL4J_TPU_SERVE_TIMEOUT_S", 60))
         self.slots = int(slots if slots is not None
                          else _env_float("DL4J_TPU_SERVE_SLOTS", 4))
+        # paged-KV plane (serving/paged.py): block size 0 = fixed pool
+        self.kv_block = int(kv_block if kv_block is not None
+                            else _env_float("DL4J_TPU_SERVE_KV_BLOCK", 16))
+        self.kv_blocks = int(kv_blocks if kv_blocks is not None
+                             else _env_float("DL4J_TPU_SERVE_KV_BLOCKS", 0))
+        # a typo'd operator spec must fail HERE, not collapse to FIFO
+        self.slo_classes = parse_slo_classes(
+            slo_classes if slo_classes is not None
+            else envknob.raw("DL4J_TPU_SERVE_SLO_CLASSES", ""))
         self.batching_enabled = (
             envknob.raw("DL4J_TPU_SERVE_BATCH", "").strip().lower()
             not in ("0", "off", "false", "no"))
@@ -243,11 +271,15 @@ class ServingEngine:
                  temperature: float = 1.0, seed: int = 0,
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
+                 slo: Optional[str] = None,
                  name=None, version=None) -> np.ndarray:
-        """LM sampling: the continuous slot pool for plain temperature
-        sampling on eligible models; lm.generate for static top_k/top_p
-        filters, mesh-sharded or MoE models (the filters are compiled
-        per-(n_new, k) there — models/transformer._filter_logits)."""
+        """LM sampling: the paged block pool (or the fixed slot pool
+        when DL4J_TPU_SERVE_KV_BLOCK=0) for plain temperature sampling
+        on eligible models; lm.generate for static top_k/top_p filters,
+        mesh-sharded or MoE models (the filters are compiled per-(n_new,
+        k) there — models/transformer._filter_logits). ``slo`` names a
+        scheduling class (serving/slo.py) — honored by the paged pool,
+        ignored by the fallback paths (which have no scheduler)."""
         rec = self.registry.get(name, version)
         breaker = self._admit(rec)
         model = rec.model
@@ -258,26 +290,34 @@ class ServingEngine:
         tokens = np.asarray(tokens, np.int32)
         if tokens.ndim == 1:
             tokens = tokens[None]
-        try:
-            out = self._generate_inner(rec, model, tokens, n_new,
-                                       temperature, seed, top_k, top_p)
-        except (RequestTimeoutError, FutureTimeoutError,
-                ClientRequestError):
-            raise  # deadlines/payloads are not model-health evidence
-        except Exception as e:  # noqa: BLE001 — serving boundary
-            breaker.record_failure(f"{type(e).__name__}: {e}")
-            raise
+        rid = next(self._rid)
+        with obs_trace.span("serve.request", rid=rid, model=rec.key,
+                            rows=int(tokens.shape[0]), kind="generate"):
+            try:
+                out = self._generate_inner(rec, model, tokens, n_new,
+                                           temperature, seed, top_k,
+                                           top_p, slo)
+            except (RequestTimeoutError, FutureTimeoutError,
+                    ClientRequestError):
+                raise  # deadlines/payloads are not model-health evidence
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                breaker.record_failure(f"{type(e).__name__}: {e}")
+                raise
         breaker.record_success()
         return out
 
     def _generate_inner(self, rec, model, tokens, n_new, temperature,
-                        seed, top_k, top_p) -> np.ndarray:
+                        seed, top_k, top_p, slo=None) -> np.ndarray:
         decoder = (self._decoder_for(rec)
                    if top_k is None and top_p is None else None)
         if decoder is not None:
+            kwargs = {}
+            if slo is not None and getattr(decoder, "supports_streaming",
+                                           False):
+                kwargs["slo"] = slo
             out = decoder.generate(tokens, int(n_new),
                                    temperature=float(temperature),
-                                   seed=int(seed))
+                                   seed=int(seed), **kwargs)
             return np.asarray(out)
         import jax.numpy as jnp
 
@@ -288,6 +328,68 @@ class ServingEngine:
                                  seed=int(seed), top_k=top_k, top_p=top_p)
         self.stats.record_tokens(int(np.asarray(out).size))
         return np.asarray(out)
+
+    def generate_stream(self, tokens, n_new: int, *,
+                        temperature: float = 1.0, seed: int = 0,
+                        slo: Optional[str] = None,
+                        name=None, version=None):
+        """Streaming /generate for ONE prompt: an iterator of sampled
+        token ids, each yielded as the decode tick produces it (paged
+        pool). The fixed pool / lm.generate fallbacks yield the same
+        wire sequence after generating fully — identical contract,
+        later first token. Admission errors (429/503/400) raise HERE,
+        before the caller commits response headers; mid-generation
+        failures raise from the iterator."""
+        rec = self.registry.get(name, version)
+        prompt = np.asarray(tokens, np.int32).reshape(-1)
+        decoder = (self._decoder_for(rec)
+                   if getattr(rec.model, "generate", None) is not None
+                   else None)
+        if decoder is None or not getattr(decoder, "supports_streaming",
+                                          False):
+            # generate() runs the admission gate itself — admitting here
+            # too would consume a half-open breaker probe twice
+            out = self.generate(prompt, n_new, temperature=temperature,
+                                seed=seed, slo=slo, name=name,
+                                version=version)
+            return iter(np.asarray(out).reshape(-1).tolist())
+        breaker = self._admit(rec)
+        rid = next(self._rid)
+        q: stdqueue.Queue = stdqueue.Queue()
+        with obs_trace.span("serve.request", rid=rid, model=rec.key,
+                            rows=1, kind="generate_stream"):
+            fut = decoder.submit(prompt, int(n_new),
+                                 temperature=float(temperature),
+                                 seed=int(seed), slo=slo, on_token=q.put)
+
+        def stream():
+            while True:
+                try:
+                    yield int(q.get(timeout=0.2))
+                    continue
+                except stdqueue.Empty:
+                    pass
+                if not fut.done():
+                    continue
+                # on_token callbacks run BEFORE the future resolves
+                # (serving/paged.py), so a done future means every token
+                # is already queued — drain, then finish
+                try:
+                    fut.result(timeout=0)
+                except (RequestTimeoutError, FutureTimeoutError,
+                        ClientRequestError):
+                    raise
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    breaker.record_failure(f"{type(e).__name__}: {e}")
+                    raise
+                breaker.record_success()
+                while True:
+                    try:
+                        yield int(q.get_nowait())
+                    except stdqueue.Empty:
+                        return
+
+        return stream()
 
     # -- internals --------------------------------------------------------
     @staticmethod
@@ -425,20 +527,36 @@ class ServingEngine:
                 return None
             decoder = self._decoders.get(rec.key)
             if decoder is None:
-                # eligibility is the KV-slot contract: a single-device
+                # eligibility is the KV-pool contract: a single-device
                 # dense TransformerLM (serving/decode.py gate)
                 if getattr(rec.model, "_run_cfg", None) is None:
                     self._no_decoder.add(rec.key)
                     return None
-                from deeplearning4j_tpu.serving.decode import (
-                    ContinuousDecoder,
-                )
-
                 try:
-                    decoder = ContinuousDecoder(
-                        rec.model, slots=self.slots, stats=self.stats,
-                        default_timeout_s=max(self.request_timeout_s, 300.0),
-                        chaos=self.chaos)
+                    if self.kv_block > 0:
+                        from deeplearning4j_tpu.serving.paged import (
+                            PagedDecoder,
+                        )
+
+                        decoder = PagedDecoder(
+                            rec.model, block_tokens=self.kv_block,
+                            n_blocks=self.kv_blocks or None,
+                            min_lanes=self.slots, stats=self.stats,
+                            default_timeout_s=max(self.request_timeout_s,
+                                                  300.0),
+                            chaos=self.chaos,
+                            slo_classes=self.slo_classes or None,
+                            queue_cap=self.queue_capacity)
+                    else:
+                        from deeplearning4j_tpu.serving.decode import (
+                            ContinuousDecoder,
+                        )
+
+                        decoder = ContinuousDecoder(
+                            rec.model, slots=self.slots, stats=self.stats,
+                            default_timeout_s=max(self.request_timeout_s,
+                                                  300.0),
+                            chaos=self.chaos)
                 except ValueError:
                     self._no_decoder.add(rec.key)
                     return None
@@ -450,6 +568,11 @@ class ServingEngine:
         engine = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer (the streaming /generate contract) is an
+            # HTTP/1.1 construct; every non-streamed response carries an
+            # explicit Content-Length, so keep-alive framing stays sound
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
@@ -500,6 +623,10 @@ class ServingEngine:
                         "models": engine.registry.describe(),
                         "default": (engine.registry.default().key
                                     if engine.registry.default() else None),
+                        # KV capacity in TOKENS per live decoder (ISSUE
+                        # 11 satellite): what the /generate plane can
+                        # actually hold, not what it pre-allocated
+                        "kv": engine.kv_report(),
                     })
                 else:
                     self._send(404, {"error": "not found"})
@@ -582,15 +709,68 @@ class ServingEngine:
                 # the compile cache key
                 tk = payload.get("top_k")
                 tp = payload.get("top_p")
+                if payload.get("stream"):
+                    if tk is not None or tp is not None:
+                        self._send(400, {"error": "stream does not "
+                                         "support top_k/top_p"})
+                        return
+                    if toks.ndim > 1 and toks.shape[0] != 1:
+                        self._send(400, {"error": "stream takes ONE "
+                                         "prompt per request"})
+                        return
+                    gen = engine.generate_stream(
+                        toks.reshape(-1), int(payload.get("n_new", 16)),
+                        temperature=float(payload.get("temperature", 1.0)),
+                        seed=int(payload.get("seed", 0)),
+                        slo=payload.get("slo"),
+                        name=payload.get("model"),
+                        version=payload.get("version"))
+                    self._stream_tokens(gen)
+                    return
                 out = engine.generate(
                     toks, int(payload.get("n_new", 16)),
                     temperature=float(payload.get("temperature", 1.0)),
                     seed=int(payload.get("seed", 0)),
                     top_k=int(tk) if tk is not None else None,
                     top_p=float(tp) if tp is not None else None,
+                    slo=payload.get("slo"),
                     name=payload.get("model"),
                     version=payload.get("version"))
                 self._send(200, {"tokens": out.tolist()})
+
+            def _stream_tokens(self, gen):
+                # manual chunked framing: one NDJSON object per token,
+                # flushed as sampled — a client reads tokens as the
+                # decode ticks produce them. Submission errors raised
+                # BEFORE this point (generate_stream submits eagerly)
+                # still map to proper status codes in do_POST;
+                # mid-generation failures can only ride the stream, the
+                # headers are gone.
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(b"%x\r\n" % len(data) + data
+                                     + b"\r\n")
+                    self.wfile.flush()
+
+                out = []
+                try:
+                    for t in gen:
+                        out.append(int(t))
+                        chunk({"token": int(t)})
+                    chunk({"done": True, "tokens": out})
+                except (RequestTimeoutError, FutureTimeoutError) as e:
+                    # timeout counters already bumped where they expired
+                    chunk({"error": f"Timeout: {e}"})
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    engine.stats.record_error()
+                    chunk({"error": f"{type(e).__name__}: {e}"})
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
 
             def _do_models(self):
                 payload = self._read_json()
@@ -620,6 +800,26 @@ class ServingEngine:
                                      "load|warmup|serve|unload"})
 
         return Handler
+
+    def kv_report(self) -> Dict[str, Any]:
+        """Per-model KV capacity in tokens (paged: arena blocks *
+        block_tokens + occupancy + cached prefix blocks; fixed pool: the
+        slots * max_len pre-allocation). Eligible decoders are built on
+        first ask — capacity is a property of the configuration, so
+        /models must report it before first /generate traffic; for
+        ineligible models _decoder_for's cheap _run_cfg probe says no
+        without pulling the transformer stack in."""
+        out: Dict[str, Any] = {}
+        for d in self.registry.describe():
+            if d["state"] in ("broken", "unloaded"):
+                continue
+            rec = self.registry.get(d["name"], d["version"])
+            if rec is None or rec.model is None:
+                continue
+            decoder = self._decoder_for(rec)
+            if decoder is not None and hasattr(decoder, "kv_capacity"):
+                out[rec.key] = decoder.kv_capacity()
+        return out
 
     def metrics(self) -> Dict[str, Any]:
         return {"serving": self.stats.snapshot(),

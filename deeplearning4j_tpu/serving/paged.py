@@ -1,0 +1,896 @@
+"""Paged-KV continuous decode: a block-pool /generate plane.
+
+The fixed slot pool (serving/decode.ContinuousDecoder) allocates each
+request a CONTIGUOUS ``cfg.max_len`` KV stripe, so concurrency quantizes
+to ``DL4J_TPU_SERVE_SLOTS`` no matter how short the requests actually
+are — the serving-side twin of the dense-batch over-allocation SURVEY
+§3.1 charges the reference's one-record route with. PagedAttention
+(Kwon et al., vLLM) fixes it with virtual memory's oldest trick: one
+device-resident BLOCK ARENA of fixed-size KV blocks, per-request block
+TABLES mapping logical token positions to physical blocks, admission
+gated by the free-block count, and eviction returning blocks to the
+free list. Iteration-level scheduling (Yu et al., Orca) stays exactly
+as the fixed pool had it: the device program is a fixed-shape
+single-token tick (zero retrace after the first tick), and ALL paging —
+allocation, preemption, prefix sharing — is host-side bookkeeping
+between ticks.
+
+Layout and invariants:
+
+  * arena k/v: ``[L, n_blocks+1, block_tokens, H, hd]``; physical block
+    0 is a TRASH block that is never allocated — inactive lanes and the
+    unallocated tail of every table point at it, so the tick's scatter
+    always has somewhere harmless to write and the gather somewhere
+    harmless to read (the ``arange <= pos`` mask zeroes its softmax
+    weight exactly, the same argument decode.py makes for garbage pad
+    K/V).
+  * the tick gathers each lane's blocks ``arena[tables]`` back into the
+    contiguous ``[S, max_len, H, hd]`` view and then runs the identical
+    per-slot masked-attention math as decode_step_slots — per-lane
+    outputs are functions of the gathered VALUES, not the physical
+    block ids, which is why a request's tokens are byte-invariant to
+    allocation history and pool co-residents (tests/test_serving_paged).
+  * prefix cache: full prompt blocks strictly BELOW a request's first
+    write position are content-addressed (chained sha256 over the
+    re-based token window) and refcounted; a hit points the new
+    request's read table at the shared physical blocks. The divergence
+    block — the one containing the re-consumed last prompt token, which
+    the first tick overwrites — is always PRIVATE: admission prefill
+    recomputes it into a fresh block (copy-on-write by recompute, one
+    code path, byte-identical to the cold path by construction), and
+    shared blocks are never written after their creating prefill.
+  * admission prefill reuses the cold path's full-window program
+    (models/transformer.prefill_cache at the bucket-ladder width) and
+    scatters ONLY private blocks (shared + beyond-prompt table entries
+    are redirected to trash in the write table), so a cache hit saves
+    HBM, not byte-determinism.
+  * on block exhaustion the YOUNGEST active request is preempted: its
+    blocks return to the free list and it is re-queued at the front of
+    its SLO class with prompt := window + generated-so-far and its live
+    PRNG key saved, so the resumed sample stream continues exactly
+    where it stopped.
+
+SLO classes (serving/slo.py) generalize the FIFO queue: admission is
+highest-class-first, per-class default deadlines feed the existing 504
+path, and queue overflow sheds the youngest request of the lowest class
+(counted per class in ``serving_stats.shed_by_class``).
+
+Dense single-device models only, same gate as ContinuousDecoder. The
+fixed-slot pool remains the ``DL4J_TPU_SERVE_KV_BLOCK=0`` fallback.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.models.transformer import (
+    TransformerConfig,
+    _ln,
+    prefill_cache,
+)
+from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.ops import dispatch
+from deeplearning4j_tpu.ops import memory as opsmem
+from deeplearning4j_tpu.serving.batcher import (
+    QueueFullError,
+    RequestTimeoutError,
+)
+from deeplearning4j_tpu.serving.resilience import (
+    ClientRequestError,
+    WorkerDeadError,
+)
+from deeplearning4j_tpu.serving.slo import SLOClass, default_classes
+from deeplearning4j_tpu.serving.telemetry import ServingStats
+
+
+def paged_decode_step(params, arena, tok, pos, tables,
+                      cfg: TransformerConfig):
+    """One decode tick over the block arena: tok [S] int32, pos [S]
+    int32, tables [S, max_len//bt] int32 -> (updated arena, logits
+    [S, V]).
+
+    The paged variant of serving/decode.decode_step_slots: the per-slot
+    cache stripe becomes a gather of the lane's blocks (``ck[tables]``
+    reshaped back to the contiguous [S, T, H, hd] view) and the one-hot
+    cache write becomes a scatter into (block, offset) =
+    (tables[s, pos//bt], pos % bt). Active lanes write distinct blocks
+    by allocation invariant; inactive lanes all scatter into trash
+    block 0, whose content is never visible under the causal mask."""
+    cdt = cfg.compute_dtype
+    s = tok.shape[0]
+    hd = cfg.d_model // cfg.n_heads
+    bt = arena["k"].shape[2]
+    t_total = tables.shape[1] * bt                    # == cfg.max_len
+    h = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
+    scale = 1.0 / float(np.sqrt(hd))
+    t_idx = jnp.arange(t_total)[None, :]              # [1, T]
+    visible = t_idx <= pos[:, None]                   # [S, T]
+    wb = jnp.take_along_axis(tables, (pos // bt)[:, None], axis=1)[:, 0]
+    off = pos % bt
+
+    def block(h, xs):
+        bp, ck, cv = xs  # ck/cv: [B, bt, H, hd]
+        c = lambda a: a.astype(cdt)
+        x = _ln(h, c(bp["ln1_g"]), c(bp["ln1_b"]))
+        q = (x @ c(bp["Wq"])).reshape(s, cfg.n_heads, hd)
+        k1 = (x @ c(bp["Wk"])).reshape(s, cfg.n_heads, hd)
+        v1 = (x @ c(bp["Wv"])).reshape(s, cfg.n_heads, hd)
+        ck = ck.at[wb, off].set(k1.astype(ck.dtype))
+        cv = cv.at[wb, off].set(v1.astype(cv.dtype))
+        kg = ck[tables].reshape(s, t_total, cfg.n_heads, hd)
+        vg = cv[tables].reshape(s, t_total, cfg.n_heads, hd)
+        sc = jnp.einsum("nhd,nthd->nht", q.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+        sc = jnp.where(visible[:, None, :], sc, -jnp.inf)
+        p = jax.nn.softmax(sc, axis=-1)
+        att = jnp.einsum("nht,nthd->nhd", p,
+                         vg.astype(jnp.float32)).reshape(s, 1, cfg.d_model)
+        h = h + att.astype(cdt) @ c(bp["Wo"])
+        x = _ln(h, c(bp["ln2_g"]), c(bp["ln2_b"]))
+        h = h + jax.nn.gelu(x @ c(bp["W1"]) + c(bp["b1"])) @ c(bp["W2"]) \
+            + c(bp["b2"])
+        return h, (ck, cv)
+
+    h, (ks, vs) = lax.scan(block, h, (params["blocks"], arena["k"],
+                                      arena["v"]))
+    h = _ln(h[:, 0].astype(jnp.float32), params["lnf_g"], params["lnf_b"])
+    return {"k": ks, "v": vs}, h @ params["embed"].T
+
+
+# jitted paged programs shared across decoder instances (the _TICK_CACHE
+# discipline from serving/decode.py): cfg is a frozen dataclass, and the
+# arena/lane shapes are jit trace dimensions, so one compiled program
+# serves every decoder with the same (cfg, block_tokens, lanes, blocks)
+_PAGED_TICK_CACHE: Dict[tuple, object] = {}
+_PAGED_ADMIT_CACHE: Dict[tuple, object] = {}
+
+
+def _paged_tick_for(cfg: TransformerConfig, block_tokens: int):
+    key = (cfg, block_tokens)
+    fn = _PAGED_TICK_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def tick(params, arena, tok, pos, tables, keys, temps):
+        arena, logits = paged_decode_step(params, arena, tok, pos, tables,
+                                          cfg)
+        split = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
+        nkeys, subs = split[:, 0], split[:, 1]
+        tempered = logits / jnp.maximum(temps, 1e-6)[:, None]
+        sampled = jax.vmap(jax.random.categorical)(subs, tempered)
+        greedy = jnp.argmax(logits, axis=-1)
+        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        return arena, nxt, nkeys
+
+    # the arena is single-owner (the worker rebinds every tick), so it
+    # donates even on CPU — an un-donated tick would memcpy the whole
+    # arena per generated token (dispatch.arena_jit)
+    tick = dispatch.arena_jit(tick, donate=(1,))
+    _PAGED_TICK_CACHE[key] = tick
+    return tick
+
+
+def _paged_admit_for(cfg: TransformerConfig, width: int, block_tokens: int):
+    key = (cfg, width, block_tokens)
+    fn = _PAGED_ADMIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    m = cfg.max_len // block_tokens
+    hd = cfg.d_model // cfg.n_heads
+
+    def admit(params, arena, window, write_table):
+        # window: [1, width]; prefill pads its K/V out to max_len, so
+        # the reshape covers every table entry. write_table redirects
+        # shared-prefix and beyond-prompt entries to trash block 0:
+        # shared blocks are NEVER written after their creating prefill
+        # (the prefix-cache byte-stability invariant).
+        c1, _ = prefill_cache(params, window, cfg)
+        kb = c1["k"][:, 0].reshape(cfg.n_layers, m, block_tokens,
+                                   cfg.n_heads, hd)
+        vb = c1["v"][:, 0].reshape(cfg.n_layers, m, block_tokens,
+                                   cfg.n_heads, hd)
+        ak = arena["k"].at[:, write_table].set(kb.astype(arena["k"].dtype))
+        av = arena["v"].at[:, write_table].set(vb.astype(arena["v"].dtype))
+        return {"k": ak, "v": av}
+
+    admit = dispatch.arena_jit(admit, donate=(1,))
+    _PAGED_ADMIT_CACHE[key] = admit
+    return admit
+
+
+class BlockArena:
+    """Host-side allocator for the device block arena: a free list plus
+    per-block refcounts (prefix-shared blocks are held by every reader
+    AND the cache itself). Physical ids run 1..usable; 0 is trash.
+    Single-owner discipline: only the decoder worker thread touches it,
+    so it needs no lock of its own."""
+
+    def __init__(self, usable: int) -> None:
+        self.usable = int(usable)
+        self._free: List[int] = list(range(self.usable, 0, -1))
+        self.refs = np.zeros((self.usable + 1,), np.int64)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.usable - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        b = self._free.pop()
+        self.refs[b] = 1
+        return b
+
+    def incref(self, block: int) -> None:
+        self.refs[block] += 1
+
+    def decref(self, block: int) -> None:
+        self.refs[block] -= 1
+        if self.refs[block] <= 0:
+            self.refs[block] = 0
+            self._free.append(block)
+
+
+class PrefixCache:
+    """Content-addressed block index: chained sha256 of the re-based
+    prompt window -> physical block id, LRU-ordered. The cache holds one
+    reference per entry, so a block survives its creating request; when
+    the free list runs dry, :meth:`reclaim` evicts least-recently-used
+    entries nobody else references."""
+
+    def __init__(self, arena: BlockArena) -> None:
+        self._arena = arena
+        self._map: "OrderedDict[bytes, int]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    @staticmethod
+    def chain_hashes(window: np.ndarray, block_tokens: int,
+                     limit: int) -> List[bytes]:
+        """Digests for full blocks [0, limit) of the window; each digest
+        covers ALL tokens up to its block's end (positions are re-based
+        to the window, so equal-content prefixes share regardless of the
+        original prompt's truncated head)."""
+        out: List[bytes] = []
+        h = b"paged-kv-v1"
+        w = np.ascontiguousarray(window.astype(np.int32, copy=False))
+        for i in range(limit):
+            h = hashlib.sha256(
+                h + w[i * block_tokens:(i + 1) * block_tokens].tobytes()
+            ).digest()
+            out.append(h)
+        return out
+
+    def lookup(self, hashes: List[bytes]) -> List[int]:
+        """Longest-prefix hit: block ids for the leading run of known
+        digests (LRU-refreshed). Caller increfs what it keeps."""
+        hits: List[int] = []
+        for h in hashes:
+            b = self._map.get(h)
+            if b is None:
+                break
+            self._map.move_to_end(h)
+            hits.append(b)
+        return hits
+
+    def insert(self, digest: bytes, block: int) -> bool:
+        if digest in self._map:
+            return False  # equal content already cached; keep ours private
+        self._map[digest] = block
+        self._arena.incref(block)
+        return True
+
+    def reclaim(self, n: int) -> int:
+        """Evict up to n LRU entries whose only reference is the cache's
+        own — returns how many blocks went back to the free list."""
+        freed = 0
+        for digest, block in list(self._map.items()):
+            if freed >= n:
+                break
+            if self._arena.refs[block] == 1:
+                del self._map[digest]
+                self._arena.decref(block)
+                freed += 1
+        return freed
+
+
+class _PendingReq:
+    __slots__ = ("prompt", "n_new", "temperature", "seed", "future",
+                 "deadline", "enqueued", "slo", "on_token", "tokens",
+                 "key_override", "seq")
+
+    def __init__(self, prompt, n_new, temperature, seed, deadline, slo,
+                 on_token, seq, future=None, tokens=None,
+                 key_override=None, enqueued=None) -> None:
+        self.prompt = prompt
+        self.n_new = n_new
+        self.temperature = temperature
+        self.seed = seed
+        self.future = future if future is not None else Future()
+        self.deadline = deadline
+        self.enqueued = enqueued if enqueued is not None \
+            else time.monotonic()
+        self.slo = slo
+        self.on_token = on_token
+        self.tokens = tokens if tokens is not None else []
+        self.key_override = key_override  # preemption-saved PRNG key
+        self.seq = seq
+
+
+class _Lane:
+    __slots__ = ("future", "tokens", "remaining", "deadline", "enqueued",
+                 "temperature", "seed", "slo", "on_token", "blocks",
+                 "n_table", "window", "admit_seq")
+
+    def __init__(self, req: _PendingReq, blocks: List[int], n_table: int,
+                 window: np.ndarray, admit_seq: int) -> None:
+        self.future = req.future
+        self.tokens = req.tokens
+        self.remaining = req.n_new
+        self.deadline = req.deadline
+        self.enqueued = req.enqueued
+        self.temperature = req.temperature
+        self.seed = req.seed
+        self.slo = req.slo
+        self.on_token = req.on_token
+        self.blocks = blocks      # every block this lane holds a ref on
+        self.n_table = n_table    # allocated read-table entries
+        self.window = window      # re-based prompt (for preempt requeue)
+        self.admit_seq = admit_seq
+
+
+class PagedDecoder:
+    """Block-pool continuous decode over a TransformerLM (the vLLM/Orca
+    scheduling pair applied to this repo's decode_step —
+    models/transformer.py:710). API-compatible with ContinuousDecoder
+    (submit/generate/drain/stop + chaos admission faults + crash
+    isolation + dead-worker fast-fail), plus ``slo=`` scheduling classes
+    and per-token ``on_token`` streaming callbacks."""
+
+    def __init__(self, lm, *, block_tokens: int = 16,
+                 n_blocks: Optional[int] = None,
+                 lanes: Optional[int] = None, min_lanes: int = 4,
+                 stats: Optional[ServingStats] = None,
+                 default_timeout_s: float = 300.0,
+                 chaos=None,
+                 slo_classes: Optional[List[SLOClass]] = None,
+                 queue_cap: Optional[int] = None) -> None:
+        cfg = lm._run_cfg
+        if lm.mesh is not None:
+            raise ValueError("paged decode needs a single-device LM "
+                             "(mesh-sharded models generate via ring/GSPMD)")
+        if cfg.moe_experts:
+            raise ValueError("paged decode does not support MoE "
+                             "(capacity routing is batch-dependent)")
+        self.lm = lm
+        self.cfg = cfg
+        bt = max(1, min(int(block_tokens), cfg.max_len))
+        while cfg.max_len % bt:
+            bt //= 2
+        self.block_tokens = bt
+        self.table_width = cfg.max_len // bt
+        if n_blocks is None:
+            n_blocks = opsmem.kv_arena_blocks(cfg, bt, params=lm.params)
+        self.n_blocks = int(n_blocks)
+        if self.n_blocks < self.table_width + 1:
+            raise ValueError(
+                f"n_blocks {self.n_blocks} cannot hold one max_len "
+                f"sequence ({self.table_width + 1} blocks)")
+        if lanes is None:
+            # sized so sequences averaging a quarter of max_len fill the
+            # arena; min_lanes keeps the fixed pool's floor, 64 caps the
+            # tick's gather width
+            est_seq = max(bt, cfg.max_len // 4)
+            lanes = max(int(min_lanes),
+                        min(64, max(1, self.n_blocks * bt // est_seq)))
+        self.lanes = int(lanes)
+        self.stats = stats if stats is not None else ServingStats()
+        self.default_timeout_s = float(default_timeout_s)
+        self.queue_cap = int(queue_cap) if queue_cap else None
+        classes = list(slo_classes) if slo_classes else \
+            default_classes(self.default_timeout_s)
+        self._classes = classes
+        self._class_map = {c.name: c for c in classes}
+        self._default_class = classes[0].name
+        self._pending: Dict[str, deque] = {c.name: deque() for c in classes}
+        self._reset_arena()
+        self._tables = np.zeros((self.lanes, self.table_width), np.int32)
+        self._tok = np.zeros((self.lanes,), np.int32)
+        self._pos = np.zeros((self.lanes,), np.int32)
+        self._temps = np.ones((self.lanes,), np.float32)
+        # np.array (not asarray): jax array views are read-only and the
+        # admit path writes per-lane key rows in place
+        self._keys = np.array(
+            jax.vmap(jax.random.PRNGKey)(jnp.zeros((self.lanes,),
+                                                   jnp.uint32)))
+        self._slots: List[Optional[_Lane]] = [None] * self.lanes
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._running = True
+        self._chaos = chaos
+        self._dead: Optional[str] = None
+        self._seq = 0        # submit/requeue order (shed picks youngest)
+        self._admit_seq = 0  # admission order (preemption picks youngest)
+        self.peak_active = 0
+        self._tick = _paged_tick_for(cfg, bt)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="paged-decoder")
+        self._worker.start()
+
+    supports_streaming = True  # engine.generate_stream dispatches on this
+
+    def _reset_arena(self) -> None:
+        """Fresh zeroed arena + allocator + prefix cache. Construction
+        and the pool-wide failure path share it: a failed DONATED tick
+        may have invalidated the old buffers, and with every lane failed
+        no block content is worth keeping — cached prefixes included
+        (they would read garbage from a reset arena)."""
+        cfg = self.cfg
+        hd = cfg.d_model // cfg.n_heads
+        shape = (cfg.n_layers, self.n_blocks + 1, self.block_tokens,
+                 cfg.n_heads, hd)
+        # two distinct buffers: k and v donate separately and must not
+        # alias each other
+        self._arena = {"k": jnp.zeros(shape, cfg.compute_dtype),
+                       "v": jnp.zeros(shape, cfg.compute_dtype)}
+        self._blocks = BlockArena(self.n_blocks)
+        self._prefix = PrefixCache(self._blocks)
+        self.stats.set_kv_blocks(0, self.n_blocks)
+
+    # -- capacity ---------------------------------------------------------
+    def kv_capacity(self) -> Dict[str, object]:
+        """/models KV report: what the arena can hold, in tokens."""
+        with self._cond:
+            in_use = self._blocks.in_use
+            tokens_in_use = sum(
+                int(self._pos[i]) + 1
+                for i, st in enumerate(self._slots) if st is not None)
+        return {
+            "scheme": "paged",
+            "block_tokens": self.block_tokens,
+            "blocks_total": self.n_blocks,
+            "blocks_in_use": in_use,
+            "capacity_tokens": self.n_blocks * self.block_tokens,
+            "tokens_in_use": tokens_in_use,
+            "lanes": self.lanes,
+            "prefix_blocks_cached": len(self._prefix),
+        }
+
+    # -- client side ------------------------------------------------------
+    def submit(self, prompt, n_new: int, temperature: float = 1.0,
+               seed: int = 0, timeout_s: Optional[float] = None,
+               slo: Optional[str] = None, on_token=None) -> Future:
+        """Queue one prompt ([T] int ids) for n_new sampled tokens;
+        returns a Future of the [n_new] int32 continuation. ``slo``
+        names a scheduling class (default: the highest-priority one);
+        ``on_token`` is called with each token as it is sampled (the
+        streaming hook — keep it fast, it runs on the decode thread)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if n_new < 1 or n_new >= self.cfg.max_len:
+            raise ValueError(f"n_new {n_new} must be in [1, max_len)")
+        cls = self._class_map.get(slo if slo is not None
+                                  else self._default_class)
+        if cls is None:
+            raise ClientRequestError(
+                f"unknown SLO class {slo!r} (have: "
+                f"{sorted(self._class_map)})")
+        keep = min(prompt.size, self.cfg.max_len - int(n_new))
+        total_blocks = (keep + int(n_new) - 2) // self.block_tokens + 1
+        if total_blocks > self.n_blocks:
+            raise ValueError(
+                f"request needs {total_blocks} blocks > arena "
+                f"{self.n_blocks}; it could never be scheduled")
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else cls.deadline_s)
+        self.stats.record_request()
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("decoder is stopped")
+            if self._dead is not None:
+                raise WorkerDeadError(
+                    f"decoder worker died ({self._dead}); prompts would "
+                    "queue forever")
+            self._seq += 1
+            req = _PendingReq(prompt, int(n_new), float(temperature),
+                              int(seed), deadline, cls.name, on_token,
+                              self._seq)
+            if self.queue_cap is not None and \
+                    self._total_pending() >= self.queue_cap:
+                victim = self._shed_for(cls)
+                if victim is None:
+                    self.stats.record_shed(cls.name)
+                    self.stats.record_rejected()
+                    raise QueueFullError(
+                        f"decode queue full ({self.queue_cap}) and no "
+                        f"lower-priority work to shed below {cls.name!r}")
+                self.stats.record_shed(victim.slo)
+                self.stats.record_rejected()
+                victim.future.set_exception(QueueFullError(
+                    f"shed by higher-priority class {cls.name!r}"))
+            self._pending[cls.name].append(req)
+            self.stats.set_queue_depth(self._total_pending(), "decode")
+            self._cond.notify_all()
+        return req.future
+
+    def generate(self, prompts, n_new: int, temperature: float = 1.0,
+                 seed: int = 0, timeout_s: Optional[float] = None,
+                 slo: Optional[str] = None) -> np.ndarray:
+        """Batch convenience: [N, T] prompts -> [N, n_new] continuations
+        (independent requests; seeds offset per row, matching
+        ContinuousDecoder.generate's contract)."""
+        prompts = np.asarray(prompts, np.int32)
+        if prompts.ndim == 1:
+            prompts = prompts[None]
+        futs = [self.submit(row, n_new, temperature=temperature,
+                            seed=seed + i, timeout_s=timeout_s, slo=slo)
+                for i, row in enumerate(prompts)]
+        budget = timeout_s if timeout_s is not None \
+            else self.default_timeout_s
+        return np.stack([f.result(timeout=budget) for f in futs])
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._worker.join(timeout=10)
+        with self._cond:
+            for q in self._pending.values():
+                for req in q:
+                    if not req.future.done():
+                        req.future.set_exception(
+                            RuntimeError("decoder stopped"))
+                q.clear()
+            for st in self._slots:
+                if st is not None and not st.future.done():
+                    st.future.set_exception(RuntimeError("decoder stopped"))
+
+    def drain(self, timeout_s: float = 20.0) -> bool:
+        """Graceful-drain support: bounded wait for the pending queues
+        and every lane to empty (admission is the engine's to stop)."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        with self._cond:
+            while (self._total_pending()
+                   or any(st is not None for st in self._slots)) \
+                    and self._dead is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+            return self._dead is None
+
+    # -- scheduler internals (call under self._cond) ----------------------
+    def _total_pending(self) -> int:
+        return sum(len(q) for q in self._pending.values())
+
+    def _shed_for(self, cls: SLOClass) -> Optional[_PendingReq]:
+        """Pop the youngest pending request of the LOWEST class strictly
+        below cls; None when nothing outranks-and-yields."""
+        for c in reversed(self._classes):
+            if c.priority <= cls.priority:
+                break
+            q = self._pending[c.name]
+            if q:
+                return q.pop()  # youngest of the lowest class
+        return None
+
+    def _release_lane(self, i: int) -> None:
+        lane = self._slots[i]
+        if lane is None:
+            return
+        for b in lane.blocks:
+            self._blocks.decref(b)
+        self._tables[i, :] = 0
+        self._slots[i] = None
+        self.stats.set_kv_blocks(self._blocks.in_use, self.n_blocks)
+
+    def _youngest_active(self) -> Optional[int]:
+        best, best_seq = None, -1
+        for i, st in enumerate(self._slots):
+            if st is not None and st.admit_seq > best_seq:
+                best, best_seq = i, st.admit_seq
+        return best
+
+    def _preempt(self, i: int) -> None:
+        """Free lane i's blocks and re-queue the request at the FRONT of
+        its class with prompt := window + generated and its live PRNG
+        key saved, so the resumed stream continues bit-where it stopped
+        (prefill recomputes the generated prefix's KV; the key stream
+        never replays a roll)."""
+        lane = self._slots[i]
+        prompt = np.concatenate(
+            [lane.window, np.asarray(lane.tokens, np.int32)])
+        self._seq += 1
+        req = _PendingReq(prompt, lane.remaining, lane.temperature,
+                          lane.seed, lane.deadline, lane.slo,
+                          lane.on_token, self._seq, future=lane.future,
+                          tokens=lane.tokens,
+                          key_override=self._keys[i].copy(),
+                          enqueued=lane.enqueued)
+        self._release_lane(i)
+        self._pending[lane.slo].appendleft(req)
+        self.stats.record_preemption()
+        self.stats.set_queue_depth(self._total_pending(), "decode")
+
+    def _grow(self, i: int) -> bool:
+        """Ensure lane i's next write block is allocated; preempts the
+        youngest admission (possibly lane i itself) on exhaustion.
+        Returns False iff lane i was preempted."""
+        lane = self._slots[i]
+        while int(self._pos[i]) // self.block_tokens >= lane.n_table:
+            b = self._blocks.alloc()
+            if b is None:
+                self._prefix.reclaim(1)
+                b = self._blocks.alloc()
+            if b is None:
+                j = self._youngest_active()
+                self._preempt(j)
+                if j == i:
+                    return False
+                continue
+            lane.blocks.append(b)
+            self._tables[i, lane.n_table] = b
+            lane.n_table += 1
+        self.stats.set_kv_blocks(self._blocks.in_use, self.n_blocks)
+        return True
+
+    def _pick_admission(self):
+        """Pop the single next admissible request (highest SLO class
+        first, FIFO within a class) and book its lane. Returns None
+        when nothing is admissible — including the head-of-line case
+        where the highest waiting class cannot fund its head request's
+        blocks: lower classes must not starve a blocked high class."""
+        free = next((i for i in range(self.lanes)
+                     if self._slots[i] is None), None)
+        if free is None:
+            return None
+        for c in self._classes:
+            q = self._pending[c.name]
+            if not q:
+                continue
+            req = q.popleft()
+            booked = self._admit_bookkeeping(free, req)
+            if booked is None:
+                q.appendleft(req)
+                return None
+            self.stats.set_queue_depth(self._total_pending(), "decode")
+            return (free,) + booked
+        return None
+
+    def _admit_bookkeeping(self, i: int, req: _PendingReq):
+        """Host-side admission under the lock: prefix lookup, block
+        allocation, table setup. Returns (buf, width, write_table,
+        inserts) for the device prefill (run OUTSIDE the lock), or None
+        when the arena cannot fund the prompt right now (the request
+        stays at the head of its class)."""
+        cfg = self.cfg
+        bt = self.block_tokens
+        keep = min(req.prompt.size, cfg.max_len - req.n_new)
+        window = np.ascontiguousarray(req.prompt[req.prompt.size - keep:])
+        wb0 = (keep - 1) // bt        # first write block: always private
+        nb_prompt = wb0 + 1
+        hashes = PrefixCache.chain_hashes(window, bt, wb0)
+        hits = self._prefix.lookup(hashes)
+        if hashes:
+            self.stats.record_prefix(len(hits), len(hashes))
+        need = nb_prompt - len(hits)
+        if self._blocks.free_count < need:
+            self._prefix.reclaim(need - self._blocks.free_count)
+        if self._blocks.free_count < need:
+            return None
+        for b in hits:
+            self._blocks.incref(b)
+        fresh = [self._blocks.alloc() for _ in range(need)]
+        read_table = np.zeros((self.table_width,), np.int32)
+        write_table = np.zeros((self.table_width,), np.int32)
+        read_table[:len(hits)] = hits
+        read_table[len(hits):nb_prompt] = fresh
+        write_table[len(hits):nb_prompt] = fresh
+        # cache candidates: private FULL blocks strictly below the write
+        # block — they are fully prompt-covered and never written again
+        inserts = [(hashes[j], int(read_table[j]))
+                   for j in range(len(hits), wb0)]
+        width = min(max(dispatch.bucket_size(keep), keep), cfg.max_len)
+        buf = np.zeros((1, width), np.int32)
+        buf[0, :keep] = window
+        self._tok[i] = int(window[-1])
+        self._pos[i] = keep - 1  # re-consume the last prompt token
+        self._temps[i] = req.temperature
+        self._keys[i] = (req.key_override if req.key_override is not None
+                         else np.asarray(jax.random.PRNGKey(req.seed)))
+        self._tables[i, :] = read_table
+        self._admit_seq += 1
+        self._slots[i] = _Lane(req, hits + fresh, nb_prompt, window,
+                               self._admit_seq)
+        self.stats.set_kv_blocks(self._blocks.in_use, self.n_blocks)
+        return buf, width, write_table, inserts
+
+    def _admit_prefill(self, buf: np.ndarray, width: int,
+                       write_table: np.ndarray) -> None:
+        self._arena = _paged_admit_for(self.cfg, width, self.block_tokens)(
+            self.lm.params, self._arena, jnp.asarray(buf),
+            jnp.asarray(write_table))
+
+    # -- worker side ------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            self._run_inner()
+        except Exception as e:  # noqa: BLE001 — worker loop boundary
+            with self._cond:
+                self._dead = f"{type(e).__name__}: {e}"
+                victims = [st for st in self._slots if st is not None]
+                for i in range(self.lanes):
+                    self._release_lane(i)
+                for q in self._pending.values():
+                    victims.extend(q)
+                    q.clear()
+                self.stats.set_queue_depth(0, "decode")
+                self._cond.notify_all()
+            self.stats.record_worker_death()
+            err = WorkerDeadError(f"decoder worker died: {self._dead}")
+            for v in victims:
+                if not v.future.done():
+                    v.future.set_exception(err)
+
+    def _fail_active_lanes(self, exc: Exception) -> None:
+        """Pool-wide device failure (one tick program covers every
+        lane): fail each active future with the real cause, return the
+        blocks, keep the decoder alive for fresh traffic."""
+        with self._cond:
+            victims = [st for st in self._slots if st is not None]
+            for i in range(self.lanes):
+                self._release_lane(i)
+            self._reset_arena()
+            self._tables[:, :] = 0
+            self._cond.notify_all()
+        for st in victims:
+            if not st.future.done():
+                st.future.set_exception(exc)
+
+    def _run_inner(self) -> None:
+        while True:
+            with self._cond:
+                now = time.monotonic()
+                for i in range(self.lanes):
+                    st = self._slots[i]
+                    if st is not None and st.deadline < now:
+                        if not st.future.done():
+                            self.stats.record_timeout()
+                            st.future.set_exception(RequestTimeoutError(
+                                "generation exceeded its deadline"))
+                        self._release_lane(i)
+                for name, q in self._pending.items():
+                    alive = deque()
+                    for req in q:
+                        if req.deadline < now and not req.future.done():
+                            self.stats.record_timeout()
+                            req.future.set_exception(RequestTimeoutError(
+                                "generation request expired in queue"))
+                        else:
+                            alive.append(req)
+                    self._pending[name] = alive
+            # admission: ONE request per pick so a request admitted
+            # later in the same pass can hit the prefix blocks an
+            # earlier prefill just cached — inserts land between
+            # prefills, and only after the block content is actually
+            # written (a crashed prefill never publishes its digests)
+            while True:
+                with self._cond:
+                    picked = self._pick_admission()
+                if picked is None:
+                    break
+                i, buf, width, write_table, inserts = picked
+                try:
+                    if self._chaos is not None:
+                        self._chaos.on_admit()
+                    self._admit_prefill(buf, width, write_table)
+                except Exception as e:  # noqa: BLE001 — lane isolation boundary
+                    # a crashed admission evicts ONLY its own lane and
+                    # returns its blocks to the free list; the prefill
+                    # wrote (at most) trash + this lane's private
+                    # blocks, so co-residents' tokens are untouched
+                    # (the PR 8 crash-eviction contract carried onto
+                    # the paged pool)
+                    with self._cond:
+                        st = self._slots[i]
+                        self._release_lane(i)
+                        self._cond.notify_all()
+                    if st is not None and not st.future.done():
+                        st.future.set_exception(e)
+                    self.stats.record_slot_crash()
+                    try:
+                        deleted = self._arena["k"].is_deleted()
+                    except Exception:  # noqa: BLE001 — probe only
+                        deleted = False
+                    if deleted:
+                        # the DONATED admit died mid-execution and took
+                        # the arena with it: co-resident KV is gone, so
+                        # honest failure beats silently garbage tokens
+                        self._fail_active_lanes(e)
+                        break
+                else:
+                    with self._cond:
+                        for digest, block in inserts:
+                            self._prefix.insert(digest, block)
+            with self._cond:
+                self.stats.set_queue_depth(self._total_pending(), "decode")
+                active = [i for i in range(self.lanes)
+                          if self._slots[i] is not None]
+                self.peak_active = max(self.peak_active, len(active))
+                if not active:
+                    if not self._running:
+                        return
+                    self._cond.wait()
+                    continue
+                for i in range(self.lanes):
+                    if self._slots[i] is not None:
+                        self._grow(i)
+                active = [i for i in range(self.lanes)
+                          if self._slots[i] is not None]
+            if not active:
+                continue
+            # one fixed-shape device tick for the whole pool (no lock
+            # held); the serve.batch span joins the request spans the
+            # engine opened (PR 7 tracer)
+            try:
+                with obs_trace.span("serve.batch", kind="decode.paged",
+                                    lanes=len(active)):
+                    self._arena, nxt, keys = self._tick(
+                        self.lm.params, self._arena,
+                        jnp.asarray(self._tok), jnp.asarray(self._pos),
+                        jnp.asarray(self._tables),
+                        jnp.asarray(self._keys),
+                        jnp.asarray(self._temps))
+                    nxt = np.asarray(nxt)
+            except Exception as e:  # noqa: BLE001 — device boundary
+                self._fail_active_lanes(e)
+                continue
+            self._keys = np.array(keys)  # writable copy (admits write rows)
+            callbacks = []
+            completions = []
+            with self._cond:
+                for i in active:
+                    st = self._slots[i]
+                    if st is None:
+                        continue
+                    t = int(nxt[i])
+                    st.tokens.append(t)
+                    self._tok[i] = t
+                    self._pos[i] += 1
+                    st.remaining -= 1
+                    self.stats.record_tokens(1)
+                    if st.on_token is not None:
+                        callbacks.append((st.on_token, t))
+                    if (st.remaining <= 0
+                            or self._pos[i] >= self.cfg.max_len - 1):
+                        completions.append(st)
+                        self._release_lane(i)
+                self._cond.notify_all()  # drain() waiters see evictions
+            # stream callbacks BEFORE resolving futures (a client
+            # iterating tokens must see the last token before done), and
+            # outside the lock (a slow client must not stall the pool)
+            for cb, t in callbacks:
+                try:
+                    cb(t)
+                except Exception:  # noqa: BLE001 — client callback boundary
+                    pass
+            for st in completions:
+                if not st.future.done():
+                    st.future.set_result(np.asarray(st.tokens, np.int32))
+                    self.stats.record_latency(time.monotonic() - st.enqueued)

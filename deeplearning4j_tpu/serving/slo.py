@@ -1,0 +1,73 @@
+"""SLO classes for the /generate scheduler (jax-free on purpose).
+
+The reference's serving route has exactly one service level — every
+record rides the same Camel queue (DL4jServeRouteBuilder.java). A
+production LM endpoint serves mixed traffic: an interactive chat turn is
+worthless after a few seconds while a batch summarization job tolerates
+minutes. SLO classes generalize the existing 429/504 backpressure into a
+small, explicit policy the paged decoder's admission loop executes:
+
+  * each class carries a default per-request deadline (its 504 budget);
+  * class ORDER in the spec is admission priority — pending prompts are
+    admitted highest class first, FIFO within a class;
+  * when the pending queue is full, a new request sheds the YOUNGEST
+    request of the LOWEST class strictly below it (recorded per class in
+    ``serving_stats.shed_by_class``), else is itself rejected 429.
+
+Spec format (``DL4J_TPU_SERVE_SLO_CLASSES``): ``name:deadline_s`` pairs,
+comma-separated, highest priority first — e.g. ``interactive:5,batch:60``.
+Empty spec = one implicit ``default`` class at the engine's request
+timeout, which reproduces the pre-SLO FIFO scheduler exactly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass(frozen=True)
+class SLOClass:
+    name: str
+    deadline_s: float
+    priority: int  # 0 = highest (spec order)
+
+
+def parse_slo_classes(spec: str) -> List[SLOClass]:
+    """``"interactive:5,batch:60"`` -> [SLOClass, ...] in priority order.
+
+    Raises ValueError on malformed entries (a typo'd operator config must
+    fail at engine construction, not silently collapse to one class).
+    """
+    out: List[SLOClass] = []
+    spec = (spec or "").strip()
+    if not spec:
+        return out
+    seen = set()
+    for i, part in enumerate(spec.split(",")):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, deadline = part.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"bad SLO class {part!r}: expected name:deadline_s")
+        if name in seen:
+            raise ValueError(f"duplicate SLO class {name!r}")
+        try:
+            deadline_s = float(deadline)
+        except ValueError:
+            raise ValueError(
+                f"bad SLO deadline {deadline!r} for class {name!r}") \
+                from None
+        if deadline_s <= 0:
+            raise ValueError(f"SLO deadline for {name!r} must be > 0")
+        seen.add(name)
+        out.append(SLOClass(name, deadline_s, len(out)))
+    return out
+
+
+def default_classes(request_timeout_s: float) -> List[SLOClass]:
+    """The implicit single-class policy (pre-SLO behavior)."""
+    return [SLOClass("default", float(request_timeout_s), 0)]

@@ -65,6 +65,15 @@ class ServingStats:
         self.warmup_failures = 0   # registry.warmup exceptions (isolated)
         self.drains_started = 0    # graceful drains begun (stop/SIGTERM)
         self.drains_completed = 0  # drains that emptied the queues in time
+        # -- paged KV plane (serving/paged.py): arena occupancy gauges,
+        # prefix-cache effectiveness, and the scheduler's preempt/shed
+        # decisions — the numbers the block-pool trade is judged by
+        self.kv_blocks_total = 0   # arena size (allocatable blocks)
+        self.kv_blocks_in_use = 0  # gauge: blocks held by lanes + cache
+        self.prefix_lookups = 0    # prompt blocks consulted in the cache
+        self.prefix_hits = 0       # prompt blocks served from the cache
+        self.preemptions = 0       # lanes evicted-and-requeued (OOB arena)
+        self.shed_by_class: Dict[str, int] = {}  # 429s per SLO class
         # per-component depths (batcher rows / decode pending prompts):
         # one shared last-writer-wins field would let an idle component
         # overwrite the backlog the other is about to 429 on
@@ -152,6 +161,26 @@ class ServingStats:
             if completed:
                 self.drains_completed += 1
 
+    # -- paged KV plane ----------------------------------------------------
+    def set_kv_blocks(self, in_use: int, total: int) -> None:
+        with self._lock:
+            self.kv_blocks_in_use = int(in_use)
+            self.kv_blocks_total = int(total)
+
+    def record_prefix(self, hits: int, lookups: int) -> None:
+        with self._lock:
+            self.prefix_hits += int(hits)
+            self.prefix_lookups += int(lookups)
+
+    def record_preemption(self) -> None:
+        with self._lock:
+            self.preemptions += 1
+
+    def record_shed(self, slo_class: str) -> None:
+        with self._lock:
+            self.shed_by_class[slo_class] = \
+                self.shed_by_class.get(slo_class, 0) + 1
+
     def set_queue_depth(self, depth: int,
                         component: str = "batcher") -> None:
         with self._lock:
@@ -204,6 +233,12 @@ class ServingStats:
                 "warmup_failures": self.warmup_failures,
                 "drains_started": self.drains_started,
                 "drains_completed": self.drains_completed,
+                "kv_blocks_total": self.kv_blocks_total,
+                "kv_blocks_in_use": self.kv_blocks_in_use,
+                "prefix_lookups": self.prefix_lookups,
+                "prefix_hits": self.prefix_hits,
+                "preemptions": self.preemptions,
+                "shed_by_class": dict(self.shed_by_class),
                 "queue_depth": sum(self.queue_depths.values()),
                 "queue_depths": dict(self.queue_depths),
             }

@@ -84,6 +84,10 @@ _QUICK_FILES = {
     # drain/isolation contracts — deterministic injected faults on tiny
     # nets, the serving third of the crash-recovery convention
     "test_serving_resilience.py",
+    # paged-KV serving plane (ISSUE 11): block-pool request independence
+    # (solo==coscheduled across prefix sharing/preemption), crash
+    # eviction, SLO shed, streaming, arena sizing — ~15s on tiny LMs
+    "test_serving_paged.py",
     # graftlint (ISSUE 10): per-rule fixture contracts + the repo-wide
     # clean sweep + the knob-table↔CLAUDE.md consistency gate — pure-AST,
     # jax-free, seconds for the fixtures and ~15s for the sweep

@@ -1,0 +1,479 @@
+"""Paged-KV serving plane (ISSUE 11): the block-pool /generate decoder.
+
+Contracts carried onto the paged pool from the fixed-slot one
+(tests/test_serving.py + tests/test_serving_resilience.py):
+
+  * request independence — a sequence's greedy tokens are byte-invariant
+    to pool co-residents, across block eviction, prefix SHARING, and
+    preemption-by-recompute (the serving twin of distributed==serial);
+  * crash eviction — a crashed admission fails only its own future and
+    returns its blocks to the free list (PR 8 semantics).
+
+New contracts this plane introduces: prefix-cache hits on shared
+prompts, per-token streaming callbacks in emission order, SLO-class
+admission (priority order, shed-youngest-of-lowest, unknown class is a
+400-class ClientRequestError), preemption recovery exactness (a
+preempted-and-re-admitted sequence re-consumes its window and replays
+NOTHING), and HBM-budgeted arena sizing (ops/memory.kv_arena_blocks).
+
+Reference anchor: the reference serves one record per route callback
+(dl4j-streaming/.../routes/DL4jServeRouteBuilder.java) — block-pool KV
+scheduling has no reference twin; provenance is the vLLM/Orca pair
+cited in serving/paged.py's module docstring.
+"""
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.resilience import (
+    InjectedServingFault,
+    ServingChaos,
+    ServingChaosConfig,
+)
+from deeplearning4j_tpu.serving import QueueFullError, ServingEngine
+from deeplearning4j_tpu.serving.resilience import ClientRequestError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_lm(**over):
+    from deeplearning4j_tpu.models.transformer import (
+        TransformerConfig,
+        TransformerLM,
+    )
+
+    kw = dict(vocab_size=29, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+              max_len=32, use_flash=False)
+    kw.update(over)
+    return TransformerLM(TransformerConfig(**kw))
+
+
+def _post(url, path, payload, timeout=120):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url, path, timeout=60):
+    with urllib.request.urlopen(url + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# request independence on the paged pool
+# ---------------------------------------------------------------------------
+
+
+class TestPagedIndependence:
+    def test_solo_equals_fixed_slot_baseline(self):
+        """The paged tick (write-then-gather through a block table) is
+        the same arithmetic as the fixed-slot pool: greedy tokens are
+        byte-identical between the two decoders."""
+        from deeplearning4j_tpu.serving.decode import ContinuousDecoder
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        d0 = ContinuousDecoder(lm, slots=2)
+        try:
+            base = d0.generate(np.asarray([[1, 5, 2, 9]]), 6,
+                               temperature=0.0)[0]
+        finally:
+            d0.stop()
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16)
+        try:
+            solo = d.generate(np.asarray([[1, 5, 2, 9]]), 6,
+                              temperature=0.0)[0]
+        finally:
+            d.stop()
+        np.testing.assert_array_equal(base, solo)
+
+    def test_coscheduled_with_prefix_sharing_equals_solo(self):
+        """Greedy tokens are invariant to co-residents EVEN WHEN the
+        co-resident physically shares prefix blocks (the shared blocks
+        are read-only to both: write tables point the hit entries at
+        trash), and the share registers as a prefix-cache hit."""
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        shared = [2, 4, 6, 8, 10, 12, 14, 16, 3, 5]  # > one 8-token block
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16)
+        try:
+            solo_a = d.generate(np.asarray([shared + [7]]), 5,
+                                temperature=0.0)[0]
+            solo_b = d.generate(np.asarray([shared + [9]]), 5,
+                                temperature=0.0)[0]
+            before = d.stats.prefix_hits
+            f1 = d.submit(shared + [7], 5, temperature=0.0)
+            f2 = d.submit(shared + [9], 5, temperature=0.0)
+            f3 = d.submit([3, 3, 4], 8, temperature=0.0)
+            np.testing.assert_array_equal(solo_a, f1.result(timeout=120))
+            np.testing.assert_array_equal(solo_b, f2.result(timeout=120))
+            f3.result(timeout=120)
+            assert d.stats.prefix_hits > before
+        finally:
+            d.stop()
+
+    def test_blocks_return_to_free_list(self):
+        """After every request completes, only prefix-cache holdings
+        remain allocated; a second wave reuses the freed blocks."""
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16)
+        try:
+            for _ in range(2):
+                d.generate(np.asarray([[1, 2, 3], [4, 5, 6], [7, 8, 9]]),
+                           6, temperature=0.0)
+                cap = d.kv_capacity()
+                assert cap["blocks_in_use"] == cap["prefix_blocks_cached"]
+                assert cap["tokens_in_use"] == 0
+        finally:
+            d.stop()
+
+    def test_preemption_recovery_is_exact(self):
+        """A block-starved arena preempts the youngest admission and
+        re-admits it later by re-consuming prompt+generated — the final
+        tokens are byte-identical to an uninterrupted run (recompute,
+        never resample: the live PRNG key rides the requeue)."""
+        from deeplearning4j_tpu.serving.decode import ContinuousDecoder
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        d0 = ContinuousDecoder(lm, slots=1)
+        try:
+            bases = [d0.generate(np.asarray([p]), 20, temperature=0.0)[0]
+                     for p in ([2, 4, 6], [1, 1, 1, 1], [9, 8, 7])]
+        finally:
+            d0.stop()
+        # 7 blocks * 8 tokens cannot hold three 23/24-token sequences
+        # at once: growth must preempt
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=7)
+        try:
+            futs = [d.submit([2, 4, 6], 20, temperature=0.0),
+                    d.submit([1, 1, 1, 1], 20, temperature=0.0),
+                    d.submit([9, 8, 7], 20, temperature=0.0)]
+            outs = [f.result(timeout=240) for f in futs]
+            assert d.stats.preemptions >= 1
+        finally:
+            d.stop()
+        for base, out in zip(bases, outs):
+            np.testing.assert_array_equal(base, out)
+
+    def test_seed_determinism_under_pool(self):
+        """Sampling is a function of the request's own seed, not of
+        block-pool scheduling: same seed twice -> same tokens."""
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16)
+        try:
+            a = d.generate(np.asarray([[4, 4, 4]]), 5, temperature=0.8,
+                           seed=7)[0]
+            b = d.generate(np.asarray([[4, 4, 4]]), 5, temperature=0.8,
+                           seed=7)[0]
+        finally:
+            d.stop()
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# crash eviction (PR 8 semantics on the paged pool)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedCrashEviction:
+    def test_crashed_admission_frees_blocks_and_spares_coresidents(self):
+        """Admission k crashes: ONLY its future fails, its blocks go
+        back to the free list, and a co-resident's greedy tokens equal
+        its solo baseline."""
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        chaos = ServingChaos(ServingChaosConfig(admit_raise_at=3))
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16, chaos=chaos)
+        try:
+            prompt = [1, 5, 2, 9]
+            solo = d.generate(np.asarray([prompt]), 8, temperature=0.0)[0]
+            long_fut = d.submit(prompt, 8, temperature=0.0)
+            time.sleep(0.05)  # let admission 2 land before the crasher
+            crash_fut = d.submit([3, 3, 4], 6, temperature=0.0)
+            with pytest.raises(InjectedServingFault):
+                crash_fut.result(timeout=60)
+            np.testing.assert_array_equal(solo,
+                                          long_fut.result(timeout=120))
+            assert d.stats.slot_crashes == 1
+            cap = d.kv_capacity()
+            assert cap["blocks_in_use"] == cap["prefix_blocks_cached"]
+            # the pool is still alive for fresh traffic
+            again = d.generate(np.asarray([prompt]), 8, temperature=0.0)[0]
+            np.testing.assert_array_equal(solo, again)
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO classes
+# ---------------------------------------------------------------------------
+
+
+class TestSLOClasses:
+    def test_parse_slo_classes(self):
+        from deeplearning4j_tpu.serving.slo import parse_slo_classes
+
+        classes = parse_slo_classes("interactive:5,batch:60")
+        assert [c.name for c in classes] == ["interactive", "batch"]
+        # priority 0 is the HIGHEST (spec order)
+        assert classes[0].priority < classes[1].priority
+        assert classes[0].deadline_s == 5.0
+        for bad in ("interactive", "a:1,a:2", "a:0", "a:-3", "a:x"):
+            with pytest.raises(ValueError):
+                parse_slo_classes(bad)
+
+    def test_unknown_class_is_client_error(self):
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+        from deeplearning4j_tpu.serving.slo import parse_slo_classes
+
+        lm = tiny_lm()
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16,
+                         slo_classes=parse_slo_classes("rt:5,bulk:60"))
+        try:
+            with pytest.raises(ClientRequestError):
+                d.submit([1, 2, 3], 4, slo="nope")
+        finally:
+            d.stop()
+
+    def test_full_queue_sheds_youngest_of_lowest_class(self):
+        """Past queue_cap a higher-priority submit sheds the youngest
+        pending request of the lowest class strictly below it; a
+        low-class submit with nothing to shed gets the 429."""
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+        from deeplearning4j_tpu.serving.slo import parse_slo_classes
+
+        lm = tiny_lm()
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16, lanes=1,
+                         slo_classes=parse_slo_classes("rt:30,bulk:30"),
+                         queue_cap=2)
+        try:
+            # the hog takes the single lane; its on_token throttle keeps
+            # the lane busy long enough for the queue choreography below
+            # to be race-free on a loaded host
+            hog = d.submit([2, 4, 6], 20, temperature=0.0,
+                           on_token=lambda t: time.sleep(0.02))
+            time.sleep(0.1)
+            old = d.submit([1, 2], 3, temperature=0.0, slo="bulk")
+            young = d.submit([3, 4], 3, temperature=0.0, slo="bulk")
+            # queue full: the rt submit sheds the YOUNGEST bulk request
+            kept = d.submit([5, 6], 3, temperature=0.0, slo="rt")
+            with pytest.raises(QueueFullError):
+                young.result(timeout=5)
+            assert d.stats.shed_by_class.get("bulk") == 1
+            # queue full again, and a bulk arrival outranks nobody: 429
+            with pytest.raises(QueueFullError):
+                d.submit([7, 8], 3, temperature=0.0, slo="bulk")
+            assert hog.result(timeout=120).shape == (20,)
+            assert old.result(timeout=120).shape == (3,)
+            assert kept.result(timeout=120).shape == (3,)
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# streaming
+# ---------------------------------------------------------------------------
+
+
+class TestStreaming:
+    def test_on_token_streams_in_emission_order(self):
+        """The callback sees every token, in order, and all of them
+        BEFORE the future resolves (a consumer observing a done future
+        may drain-then-stop without losing tokens)."""
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        d = PagedDecoder(lm, block_tokens=8, n_blocks=16)
+        try:
+            streamed = []
+            fut = d.submit([1, 5, 2, 9], 6, temperature=0.0,
+                           on_token=streamed.append)
+            out = fut.result(timeout=120)
+            assert streamed == list(out)
+        finally:
+            d.stop()
+
+    def test_http_stream_matches_nonstream(self):
+        """POST /generate with stream=true chunks NDJSON token events
+        and a final done record whose tokens equal the non-streaming
+        response for the same request."""
+        lm = tiny_lm()
+        eng = ServingEngine(model=lm, kv_block=8, kv_blocks=16).start()
+        try:
+            plain = _post(eng.url, "/generate",
+                          {"tokens": [1, 5, 2, 9], "n_new": 6,
+                           "temperature": 0.0})["tokens"][0]
+            req = urllib.request.Request(
+                eng.url + "/generate",
+                data=json.dumps({"tokens": [1, 5, 2, 9], "n_new": 6,
+                                 "temperature": 0.0,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert resp.headers.get("Content-Type") == \
+                    "application/x-ndjson"
+                events = [json.loads(ln) for ln in resp.read().splitlines()
+                          if ln.strip()]
+            toks = [e["token"] for e in events if "token" in e]
+            done = [e for e in events if e.get("done")]
+            assert toks == plain
+            assert done and done[0]["tokens"] == plain
+        finally:
+            eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: default paged, KV_BLOCK=0 fallback, /models report
+# ---------------------------------------------------------------------------
+
+
+class TestEngineIntegration:
+    def test_paged_default_and_fixed_slot_fallback_agree(self):
+        """kv_block>0 (the default) serves /generate from the paged
+        pool; kv_block=0 falls back to the fixed-slot decoder; both
+        return identical greedy tokens and report their scheme (and
+        capacity in tokens) at /models."""
+        lm = tiny_lm()
+        eng = ServingEngine(model=lm, kv_block=8, kv_blocks=16).start()
+        try:
+            paged = _post(eng.url, "/generate",
+                          {"tokens": [1, 5, 2, 9], "n_new": 6,
+                           "temperature": 0.0})["tokens"][0]
+            kv = _get(eng.url, "/models")["kv"]["default@v1"]
+            assert kv["scheme"] == "paged"
+            assert kv["capacity_tokens"] == 16 * 8
+        finally:
+            eng.stop()
+        eng = ServingEngine(model=lm, kv_block=0).start()
+        try:
+            fixed = _post(eng.url, "/generate",
+                          {"tokens": [1, 5, 2, 9], "n_new": 6,
+                           "temperature": 0.0})["tokens"][0]
+            kv = _get(eng.url, "/models")["kv"]["default@v1"]
+            assert kv["scheme"] == "fixed-slot"
+            assert kv["capacity_tokens"] == kv["slots"] * 32
+        finally:
+            eng.stop()
+        assert paged == fixed
+
+    def test_http_slo_routing_and_unknown_class_400(self):
+        lm = tiny_lm()
+        eng = ServingEngine(model=lm, kv_block=8, kv_blocks=16,
+                            slo_classes="interactive:30,batch:120").start()
+        try:
+            out = _post(eng.url, "/generate",
+                        {"tokens": [1, 5, 2, 9], "n_new": 3,
+                         "temperature": 0.0, "slo": "interactive"})
+            assert len(out["tokens"][0]) == 3
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(eng.url, "/generate",
+                      {"tokens": [1, 2], "n_new": 2, "slo": "nope"})
+            assert exc.value.code == 400
+        finally:
+            eng.stop()
+
+    def test_bad_slo_spec_fails_at_construction(self):
+        with pytest.raises(ValueError):
+            ServingEngine(model=tiny_lm(), slo_classes="oops")
+
+
+# ---------------------------------------------------------------------------
+# arena sizing (the fixed-pool over-allocation fix)
+# ---------------------------------------------------------------------------
+
+
+class TestArenaSizing:
+    def test_kv_block_bytes_closed_form(self):
+        from deeplearning4j_tpu.models.transformer import TransformerConfig
+        from deeplearning4j_tpu.ops.memory import kv_block_bytes
+
+        cfg = TransformerConfig(vocab_size=29, d_model=16, n_layers=2,
+                                n_heads=2, d_ff=32, max_len=32)
+        # k+v, per layer: bt * H * hd elements
+        itemsize = np.dtype(cfg.compute_dtype).itemsize
+        assert kv_block_bytes(cfg, 8) == 2 * 2 * 8 * 16 * itemsize
+
+    def test_kv_arena_blocks_respects_budget_and_floor(self):
+        from deeplearning4j_tpu.models.transformer import TransformerConfig
+        from deeplearning4j_tpu.ops.memory import (
+            kv_arena_blocks,
+            kv_block_bytes,
+        )
+
+        cfg = TransformerConfig(vocab_size=29, d_model=16, n_layers=2,
+                                n_heads=2, d_ff=32, max_len=32)
+        per = kv_block_bytes(cfg, 8)
+        # budget for exactly 10 blocks at kv_fraction=1.0
+        gb = 10 * per / 2**30
+        assert kv_arena_blocks(cfg, 8, hbm_gb=gb, kv_fraction=1.0) == 10
+        # a starvation budget still floors at one max_len sequence + 1
+        floor = cfg.max_len // 8 + 1
+        assert kv_arena_blocks(cfg, 8, hbm_gb=1e-9,
+                               kv_fraction=1.0) == floor
+
+    def test_arena_too_small_for_one_sequence_raises(self):
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        with pytest.raises(ValueError):
+            PagedDecoder(tiny_lm(), block_tokens=8, n_blocks=4)
+
+    def test_block_tokens_auto_divides_max_len(self):
+        from deeplearning4j_tpu.serving.paged import PagedDecoder
+
+        lm = tiny_lm()
+        d = PagedDecoder(lm, block_tokens=12, n_blocks=40)
+        try:
+            assert lm.cfg.max_len % d.block_tokens == 0
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# ledger + bench registration
+# ---------------------------------------------------------------------------
+
+
+class TestPlumbing:
+    def test_new_ledger_fields_in_snapshot(self):
+        from deeplearning4j_tpu.serving.telemetry import ServingStats
+
+        s = ServingStats()
+        s.set_kv_blocks(3, 16)
+        s.record_prefix(1, 2)
+        s.record_preemption()
+        s.record_shed("bulk")
+        snap = s.snapshot()
+        assert snap["kv_blocks_in_use"] == 3
+        assert snap["kv_blocks_total"] == 16
+        assert snap["prefix_hits"] == 1 and snap["prefix_lookups"] == 2
+        assert snap["preemptions"] == 1
+        assert snap["shed_by_class"] == {"bulk": 1}
+
+    def test_serving_decode_leg_registered(self):
+        """bench.py defines the serving_decode leg, bench_state expects
+        it, and it is pinned CPU-only (the leg is a scheduler benchmark,
+        not a chip benchmark)."""
+        from scripts.bench_state import EXPECTED
+
+        assert "serving_decode" in EXPECTED
+        src = open(os.path.join(REPO, "bench.py")).read()
+        legs = set(re.findall(r'^\s*run\("([a-z0-9_]+)"', src, re.M))
+        assert "serving_decode" in legs
+        cpu_only = re.search(r"_CPU_ONLY_LEGS\s*=\s*\{([^}]*)\}", src)
+        assert cpu_only and "serving_decode" in cpu_only.group(1)
