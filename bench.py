@@ -1184,6 +1184,210 @@ def bench_serving_resilience(clients=16, per_client=8):
 
 
 # ---------------------------------------------------------------------------
+# serving_fleet: router+replica tier (ISSUE 12 — serving/fleet.py +
+# serving/router.py). CPU-only by design: on this 1-core host replicas
+# share the core, so the replica-count sweep measures ROUTER overhead
+# (proxy hop + breaker/SLO accounting per request) staying flat as the
+# tier widens — not parallel speedup — and the kill leg measures the
+# failover machinery (connect-failure verdict -> breaker vote ->
+# retry-on-survivor -> board expiry -> restart -> re-admission), all of
+# which is host-side bookkeeping that exists unchanged on every backend.
+# Acceptance bar: ZERO failed admitted requests across the chaos kill,
+# with the end-to-end time-to-recover committed in the row.
+# ---------------------------------------------------------------------------
+
+_SERVING_FLEET_SCRIPT = r"""
+import json, sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import urllib.error, urllib.request
+import numpy as np
+from concurrent.futures import ThreadPoolExecutor
+from deeplearning4j_tpu.nn.conf import (DenseLayer, NeuralNetConfiguration,
+                                        OutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience import RouterChaos, RouterChaosConfig
+from deeplearning4j_tpu.serving.fleet import ServingFleet
+from deeplearning4j_tpu.serving.registry import bucket_ladder
+
+clients, per_client = int(sys.argv[1]), int(sys.argv[2])
+conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.01)
+        .updater("adam").list()
+        .layer(0, DenseLayer(n_in=256, n_out=256, activation="relu"))
+        .layer(1, DenseLayer(n_in=256, n_out=128, activation="relu"))
+        .layer(2, OutputLayer(n_in=128, n_out=10, activation="softmax",
+                              loss_function="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+rng = np.random.default_rng(0)
+rows = rng.standard_normal((clients, 256)).astype(np.float32)
+n_requests = clients * per_client
+# thread-mode replicas share the model object, so one warm pass fills the
+# jit cache for every replica count (the bucket ladder + batch-1)
+for b in sorted(set(bucket_ladder(64)) | {1}):
+    np.asarray(net.output(np.zeros((b, 256), np.float32)))
+
+
+def post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url + "/predict", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except OSError:
+        return -1
+
+
+def drive(url, n):
+    lat, codes, lock = [], [], threading.Lock()
+
+    def one(i):
+        t0 = time.perf_counter()
+        c = post(url, {"batch": rows[i % clients][None].tolist()})
+        dt = time.perf_counter() - t0
+        with lock:
+            lat.append(dt)
+            codes.append(c)
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=clients) as ex:
+        list(ex.map(one, range(n)))
+    return time.perf_counter() - t0, sorted(lat), codes
+
+
+replica_rows = {}
+for n_rep in (1, 2, 4):
+    fleet = ServingFleet(model=net, replicas=n_rep,
+                         heartbeat_s=0.5).start()
+    try:
+        drive(fleet.url, clients * 2)  # warm every replica + the router
+        wall, lat, codes = drive(fleet.url, n_requests)
+        bad = sum(1 for c in codes if c != 200)
+        assert bad == 0, f"{bad} non-200s at {n_rep} replicas"
+        replica_rows[str(n_rep)] = {
+            "rps": round(n_requests / wall, 1),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "p99_ms": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 2),
+        }
+    finally:
+        fleet.stop()
+
+# chaos kill mid-stream: r0 hard-dies after `kill_after` proxied requests
+# (RouterChaos verdict, enacted by the fleet's kill hook); the bar is
+# ZERO failed admitted requests. Recovery is timed end to end through
+# the PUBLIC router API: kill instant -> restart_replica -> first
+# /health scrape whose routable set includes r0 again.
+kill_after = max(4, n_requests // 4)
+chaos = RouterChaos(RouterChaosConfig(
+    kill_replica={"replica": "r0", "after_proxied": kill_after}))
+fleet = ServingFleet(model=net, replicas=2, heartbeat_s=0.25, chaos=chaos,
+                     router_kwargs={"poll_s": 0.1})
+times = {}
+enact = fleet.router.on_kill
+
+
+def on_kill(rid):
+    times["kill"] = time.monotonic()
+    enact(rid)
+
+
+fleet.router.on_kill = on_kill
+fleet.start()
+result = {}
+t = threading.Thread(
+    target=lambda: result.update(
+        zip(("wall", "lat", "codes"), drive(fleet.url, n_requests))))
+t.start()
+while "kill" not in times and t.is_alive():
+    time.sleep(0.01)
+assert "kill" in times, "chaos kill never fired"
+recover_s = None
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    try:
+        fleet.restart_replica("r0")
+        break
+    except ValueError:
+        time.sleep(0.005)  # kill() may still be mid-enactment
+# recovered == the router's PUBLIC /replicas view shows r0 at the NEW
+# incarnation's address, probed ready, breaker serving — the stale
+# pre-kill table entry (optimistic ready, unopened breaker) must not
+# count as recovery
+new_url = fleet.engines()["r0"].url
+while time.monotonic() < deadline:
+    try:
+        with urllib.request.urlopen(fleet.url + "/replicas",
+                                    timeout=5) as r:
+            body = json.loads(r.read())
+        d = body.get("r0")
+        if (d and d["url"] == new_url and d["ready"]
+                and d["breaker"]["state"] == "serving"):
+            recover_s = time.monotonic() - times["kill"]
+            break
+    except OSError:
+        pass
+    time.sleep(0.02)
+t.join()
+failed = sum(1 for c in result["codes"] if c != 200)
+snap = fleet.router.stats.snapshot()
+fleet.stop()
+assert failed == 0, f"{failed} admitted requests failed across the kill"
+assert recover_s is not None, "killed replica never re-admitted"
+
+r1 = replica_rows["1"]["rps"]
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "clients": clients,
+    "requests_per_leg": n_requests,
+    "replicas": replica_rows,
+    "router_rps_ratio_2v1": round(replica_rows["2"]["rps"] / r1, 3),
+    "router_rps_ratio_4v1": round(replica_rows["4"]["rps"] / r1, 3),
+    "kill": {
+        "requests": n_requests,
+        "failed": failed,
+        "kill_after_proxied": kill_after,
+        "retries": snap["retries"],
+        "replica_failures": snap["replica_failures"],
+        "breaker_opens": snap["breaker_opens"],
+        "time_to_recover_s": round(recover_s, 3),
+    },
+    "stat": "rps + latency through the public router HTTP API per "
+            "replica count; recovery = kill instant -> restart -> first "
+            "/replicas scrape showing the NEW incarnation's address "
+            "ready with a serving breaker",
+    "note": "1-core host: replicas share the core, so the sweep bounds "
+            "ROUTER overhead (ratios ~1.0 == the proxy hop scales), not "
+            "parallel speedup; failover/recover timings are host-side "
+            "and backend-independent",
+}))
+"""
+
+
+def bench_serving_fleet(clients=8, per_client=12):
+    """Serving fleet leg (serving/fleet.py + serving/router.py): rps/p99
+    through the public FleetRouter API at 1/2/4 replicas, plus the
+    zero-loss chaos-kill contract — a replica hard-killed mid-stream
+    must fail ZERO admitted requests (retry-on-survivor) — with the
+    end-to-end time-to-recover (kill -> restart -> routable again).
+    Subprocess-isolated, CPU-only by design: router accounting and
+    failover are host-side on every backend."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _SERVING_FLEET_SCRIPT, str(clients),
+         str(per_client)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
 # serving_decode: paged block-pool /generate vs the fixed slot pool at
 # EQUAL KV HBM budget (ISSUE 11 — serving/paged.py). CPU-only by design:
 # the contested resource is KV capacity and the win is scheduling
@@ -2403,7 +2607,7 @@ def _run_isolated(name: str, quick: bool, timeout_s: int = 0,
 # CPU-for-CPU baseline pair (forced jax-CPU by design).
 _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "native_feed", "dispatch_overhead", "serving_throughput",
-                  "serving_resilience", "serving_decode",
+                  "serving_resilience", "serving_decode", "serving_fleet",
                   "checkpoint_overhead",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
@@ -2604,7 +2808,7 @@ def main():
             elif name in ("scaling_virtual8", "north_star", "lstm_kernel",
                           "dispatch_overhead", "serving_throughput",
                           "serving_resilience", "serving_decode",
-                          "checkpoint_overhead",
+                          "serving_fleet", "checkpoint_overhead",
                           "lenet5_cpu", "char_rnn_cpu", "remat_memory",
                           "input_pipeline", "elastic_dp", "obs_overhead"):
                 # already subprocess-isolated internally
@@ -2668,6 +2872,8 @@ def main():
         streams=16, n_new=12 if quick else 24)
     run("serving_resilience", bench_serving_resilience,
         per_client=4 if quick else 8)
+    run("serving_fleet", bench_serving_fleet,
+        per_client=4 if quick else 12)
     run("checkpoint_overhead", bench_checkpoint_overhead,
         steps=12 if quick else 30)
     run("input_pipeline", bench_input_pipeline,

@@ -159,6 +159,13 @@ _register("DL4J_TPU_SERVE_SLO_CLASSES", "", "str",
           "SLO scheduling classes 'name:deadline_s,...' highest "
           "priority first ('' = one default class at the request "
           "timeout)")
+_register("DL4J_TPU_SERVE_FLEET_REPLICAS", "2", "int",
+          "serving-fleet replica count (ServingFleet default)")
+_register("DL4J_TPU_SERVE_ROUTER_PORT", "0", "int",
+          "FleetRouter HTTP port (0 = ephemeral)")
+_register("DL4J_TPU_SERVE_REPLICA_FAILS", "3", "int",
+          "consecutive connect/5xx failures that eject a replica from "
+          "the router (0 disables replica breakers)")
 
 # resilience / checkpointing (resilience/)
 _register("DL4J_TPU_CKPT_EVERY", "0", "int",

@@ -16,6 +16,9 @@ from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     FleetChaosConfig,
     InjectedKill,
     InjectedServingFault,
+    ReplicaPartitioned,
+    RouterChaos,
+    RouterChaosConfig,
     ServingChaos,
     ServingChaosConfig,
     TransientDeviceError,

@@ -382,6 +382,102 @@ class ServingChaos:
                 f"injected decode-slot crash at admission {k}")
 
 
+# ---------------------------------------------------------------------------
+# Serving-fleet faults (ISSUE 12): deterministic failures for the
+# replicated serving tier (serving/fleet.py + serving/router.py) — a
+# replica killed mid-request-stream (the observed dominant failure mode on
+# this host: process death, BENCH_r02–r05) and a router-side partition to
+# one replica (connect failures without any process dying — the breaker
+# ejection/half-open-readmission path). Same contract as the other
+# configs: config-driven only, never ambient — a router without a
+# configured RouterChaos is byte-identical to one built before this
+# existed.
+# ---------------------------------------------------------------------------
+
+
+class ReplicaPartitioned(ConnectionError):
+    """A chaos-injected router->replica partition: the router's HTTP call
+    fails at connect time exactly as if the replica's port went away —
+    the replica-breaker vote path, without any process actually dying."""
+
+
+@dataclass
+class RouterChaosConfig:
+    """Declarative fleet-serving fault plan. Counts are 1-based over the
+    router-side event they key on — PROXIED requests for kill_replica
+    (deterministic under concurrency: the k-th request the router
+    completes, whichever replica served it), per-replica CALL attempts
+    for partition_replica.
+
+      kill_replica      — {"replica": id, "after_proxied": k}: once the
+                          router has completed k requests, replica `id`
+                          is killed HARD (no drain, no goodbye — the
+                          router's kill hook enacts it via
+                          ServingFleet.kill_replica). Heartbeat expiry
+                          and connect errors must between them detect
+                          the death; every already-admitted /predict
+                          must be answered by a survivor.
+      partition_replica — {"replica": id, "calls": k}: the first k
+                          router->replica calls addressed to `id` raise
+                          :class:`ReplicaPartitioned` before any bytes
+                          are sent; the breaker walks the replica to
+                          ejection, then half-open probes re-admit it
+                          once the partition heals (calls exhausted).
+    """
+
+    kill_replica: Optional[dict] = None
+    partition_replica: Optional[dict] = None
+
+
+class RouterChaos:
+    """Stateful executor of a :class:`RouterChaosConfig`, consulted by
+    the FleetRouter (per replica call and per completed proxy). The
+    router never owns replica processes, so :meth:`kill_due` only
+    RETURNS the victim id — the fleet's kill hook enacts it (the same
+    decide-vs-enact split as FleetChaos.kill_on_poll). Deterministic:
+    the same config against the same request sequence injects the same
+    faults exactly once each."""
+
+    def __init__(self, config: RouterChaosConfig):
+        if isinstance(config, dict):
+            config = RouterChaosConfig(**config)
+        self.config = config
+        c = config.partition_replica or {}
+        self._partition_calls_left = int(c.get("calls", 0))
+        self._killed = False
+        self._proxied = 0
+        self._lock = threading.Lock()
+        self.log: list = []  # (count, fault) audit trail for tests
+
+    def on_replica_call(self, replica_id: str) -> None:
+        """Router-side, before each HTTP call to `replica_id`."""
+        c = self.config.partition_replica
+        if c is None or replica_id != c.get("replica"):
+            return
+        with self._lock:
+            if self._partition_calls_left <= 0:
+                return
+            self._partition_calls_left -= 1
+            left = self._partition_calls_left
+            self.log.append((replica_id, "partition"))
+        raise ReplicaPartitioned(
+            f"injected router partition to {replica_id!r} "
+            f"({left} calls left)")
+
+    def kill_due(self) -> Optional[str]:
+        """Router-side, after each COMPLETED proxy: the replica id to
+        kill now, or None. Fires at most once."""
+        c = self.config.kill_replica
+        with self._lock:
+            self._proxied += 1
+            if (c is None or self._killed
+                    or self._proxied < int(c.get("after_proxied", 1))):
+                return None
+            self._killed = True
+            self.log.append((self._proxied, f"kill_replica:{c['replica']}"))
+            return str(c["replica"])
+
+
 def truncate_file(path: str, keep: int = 16) -> None:
     """Write-then-truncate fault: keep only the first `keep` bytes (a
     crash mid-write that an atomic rename would normally prevent —

@@ -49,6 +49,17 @@ package concentrates the counter-measures:
                 wiring live on the engine; deterministic fault injection
                 in resilience/chaos.ServingChaosConfig.
 
+  router.py     FleetRouter — the health-routed front door over N
+                replicas (ISSUE 12): membership from the PR 6 board,
+                replica-level circuit breakers (eject on connect/5xx,
+                half-open re-admit), retry-on-survivor for idempotent
+                /predict, fleet-wide SLO shed, rolling rollout with
+                auto-rollback.
+  fleet.py      ServingFleet / run_replica — replica lifecycle: N
+                in-process engines or OS processes, each heartbeating
+                the membership board; SIGTERM -> engine drain ->
+                deregister goodbye; hard kill -> heartbeat expiry.
+
 streaming/serving.py's ModelServer remains the compatibility surface: a
 thin subclass of ServingEngine with the original single-model contract.
 """
@@ -80,9 +91,12 @@ __all__ = [
     "DrainingError",
     "DynamicBatcher",
     "InferenceWatchdog",
+    "FleetRouter",
     "ModelRegistry",
     "ModelWedgedError",
     "PagedDecoder",
+    "RouterStats",
+    "ServingFleet",
     "QueueFullError",
     "RequestTimeoutError",
     "SLOClass",
@@ -106,4 +120,14 @@ def __getattr__(name):
         from deeplearning4j_tpu.serving.paged import PagedDecoder
 
         return PagedDecoder
+    # the fleet tier (ISSUE 12) resolves lazily too: a single-engine
+    # server never needs the router/membership plumbing
+    if name in ("FleetRouter", "RouterStats"):
+        from deeplearning4j_tpu.serving import router as _router
+
+        return getattr(_router, name)
+    if name == "ServingFleet":
+        from deeplearning4j_tpu.serving.fleet import ServingFleet
+
+        return ServingFleet
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -597,6 +597,21 @@ class ServingEngine:
                     # draining) so a load balancer actually routes away
                     code, body = engine.health()
                     self._send(code, body)
+                elif self.path.split("?")[0] == "/health":
+                    # liveness/readiness split (ISSUE 12 satellite): the
+                    # plain path above keeps its 503-when-draining
+                    # contract BYTE-unchanged; ?ready=1 is the router's
+                    # probe — an answered 503 with live=true means
+                    # alive-but-not-ready (drain), which must stop
+                    # ADMISSION without voting on the replica breaker
+                    # (only a connection-level failure means death)
+                    query = self.path.partition("?")[2]
+                    if "ready=1" in query.split("&"):
+                        code, body = engine.readiness()
+                        self._send(code, body)
+                    else:
+                        code, body = engine.health()
+                        self._send(code, body)
                 elif self.path.split("?")[0] == "/metrics":
                     # content negotiation: a Prometheus scraper (Accept:
                     # text/plain / openmetrics, or an explicit
@@ -866,6 +881,22 @@ class ServingEngine:
         }
         return (200 if ok else 503), body
 
+    def readiness(self):
+        """(http_code, body) for /health?ready=1 — the liveness vs
+        readiness split (ISSUE 12 satellite). Liveness is answering at
+        all: ``live`` is constant True in every response this process
+        manages to send (a dead replica answers with a connection error,
+        not a body). Readiness is plain /health's ok bit: draining or
+        all-broken => 503 + ready=false. A router reads the difference
+        as admission-vs-ejection — an answered not-ready response stops
+        NEW traffic without counting as a breaker failure, so a graceful
+        drain is never misread as replica death."""
+        code, body = self.health()
+        body = dict(body)
+        body["live"] = True
+        body["ready"] = body["ok"]
+        return code, body
+
     def retire(self, name, version=None) -> None:
         """Unload a record AND tear down its batcher/decoder."""
         rec = self.registry.get(name, version)
@@ -897,6 +928,13 @@ class ServingEngine:
         admitted was answered within the deadline."""
         budget = float(timeout_s if timeout_s is not None else self.drain_s)
         self._draining = True
+        # seal BEFORE waiting on queues (ISSUE 12 satellite): a rollout
+        # racing this drain (an HTTP /models thread mid load -> warmup ->
+        # serve) must not promote a half-warmed record as the serving
+        # default on an engine that is going down — the drain answers
+        # admitted work against the STABLE default, and the SIGTERM path
+        # (_preempt_stop -> stop -> drain) inherits the same ordering
+        self.registry.seal()
         obs_journal.event("serve.drain", drain_s=budget)
         deadline = time.monotonic() + budget
         with self._engine_lock:
@@ -976,6 +1014,19 @@ class ServingEngine:
     def _preempt_stop(self, signum: int) -> None:
         obs_journal.event("serve.preempt", signum=signum)
         self.stop(drain=True)
+
+    @property
+    def draining(self) -> bool:
+        """Admission closed (stop()/drain()/SIGTERM). A replica process
+        (serving/fleet.run_replica) polls this to know the signal landed
+        without touching signal state itself."""
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """A full drain() pass completed — every admitted request was
+        answered (or the drain deadline expired honestly)."""
+        return self._drained
 
     @property
     def url(self) -> str:

@@ -109,6 +109,28 @@ class ModelRegistry:
         # (serving/telemetry.ServingStats) counts the isolations
         self.chaos = chaos
         self.stats = stats
+        self._sealed = False
+
+    def seal(self) -> None:
+        """Freeze the lifecycle for shutdown (ISSUE 12 satellite): the
+        engine seals the registry the moment its drain begins, so a
+        rollout racing the drain (an HTTP /models thread mid load ->
+        warmup -> serve) can never promote a half-warmed record as the
+        serving default while the engine is going down — load/warmup
+        isolation holds ACROSS drain, not just across failures. Sealed
+        load/warmup/serve raise DrainingError (HTTP 503 + Retry-After,
+        like any admission during drain); unload stays legal — teardown
+        must still free device buffers."""
+        with self._lock:
+            self._sealed = True
+
+    def _check_sealed(self) -> None:
+        if self._sealed:
+            from deeplearning4j_tpu.serving.resilience import DrainingError
+
+            raise DrainingError(
+                "registry is sealed (engine draining); lifecycle "
+                "mutations refused")
 
     # -- lifecycle --------------------------------------------------------
     def load(self, name: str, model=None, model_path: Optional[str] = None,
@@ -126,6 +148,7 @@ class ModelRegistry:
         (the rollback primitive)."""
         if model is None and model_path is None:
             raise ValueError("need model or model_path")
+        self._check_sealed()
         try:
             if self.chaos is not None:
                 self.chaos.on_load(name)
@@ -186,6 +209,7 @@ class ModelRegistry:
         — no input_shape but a generate() — warm with a [b, 2] id batch).
         ``gen_tokens > 0`` additionally warms the LM sampler for that
         n_new (one compile per n_new — models/transformer._sample_kv_fn)."""
+        self._check_sealed()
         rec = self.get(name, version)
         model = rec.model
         if model is None:
@@ -235,8 +259,10 @@ class ModelRegistry:
     def serve(self, name: Optional[str] = None,
               version: Optional[int] = None) -> ModelRecord:
         """Make (name, version) the default traffic target. Refuses a
-        broken record: promoting a failed rollout would move traffic ONTO
-        the failure the isolation just contained."""
+        broken record (promoting a failed rollout would move traffic ONTO
+        the failure the isolation just contained) and a sealed registry
+        (a drain-racing rollout must not move traffic on a dying engine)."""
+        self._check_sealed()
         rec = self.get(name, version)
         if rec.state == "broken":
             raise ValueError(

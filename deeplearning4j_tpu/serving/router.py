@@ -1,0 +1,1026 @@
+"""FleetRouter: health-routed HTTP front door over N serving replicas.
+
+The reference's scaleout tree exists so one JVM is never the whole story
+(SURVEY: deeplearning4j-scaleout spark/akka/zookeeper modules), but its
+serving side stayed a single Camel route (DL4jServeRouteBuilder.java) —
+one process, no failover. This module is the serving twin of the PR 6
+training fleet: N :class:`~deeplearning4j_tpu.serving.engine.ServingEngine`
+replicas (in-process threads or OS processes — serving/fleet.py) fronted
+by a stdlib-HTTP router that routes by per-replica health.
+
+Planes, and how they compose:
+
+  membership   The router polls the PR 6 ``FileMembershipBoard``
+               (parallel/fleet.py): a replica joins by heartbeat file +
+               a ``replica-<id>.addr`` JSON beside it; announced SIGTERM
+               departure (drain + deregister) and heartbeat expiry both
+               remove it from the table. A board read failure is a
+               PARTITION (kept last-known membership + counted in
+               ``membership_fallbacks``), never "fleet empty".
+  readiness    Per replica the router probes ``/health?ready=1`` (the
+               ISSUE 12 liveness/readiness split): an ANSWERED 503 means
+               alive-but-not-ready (draining / all models broken) — the
+               replica stops taking NEW traffic with no breaker vote; a
+               connection-level failure means the process is gone.
+  replica      A replica-level CircuitBreaker (serving/resilience.py —
+  breakers     the per-model breaker reused one level up) fed ONLY by
+               the request path: consecutive connect/5xx failures eject
+               the replica; after the cooldown one half-open probe
+               request rides through and its success re-admits. The
+               readiness poll never votes — a drain or a health blip
+               must not walk a replica to ejection, and a partitioned
+               replica must not be healed by answered health probes.
+  retry        /predict is idempotent: when a replica dies mid-request
+               (connection error — no response bytes) the request is
+               retried on a surviving replica, so admitted work is
+               never silently lost (the fleet no-drop idea applied to
+               serving). /generate retries ONLY while no bytes were
+               exchanged (sampling is stateful per request).
+  SLO shed     Fleet-wide overload policy over the PR 11 slo.py classes:
+               an in-flight cap with per-class headroom — priority p of
+               n classes is admitted while the router's in-flight count
+               is below ``cap * (n - p) / n`` — so under overload the
+               lowest class sheds (429 + Retry-After, counted per class)
+               while the highest still gets the full cap.
+  rollout      Rolling model rollout rides the registry's load/warmup
+               isolation (PR 8): per replica load -> warmup (bucket
+               ladder pre-compiled BEFORE traffic) -> serve, one replica
+               at a time; any failure auto-rolls already-shifted
+               replicas back to their recorded prior default and stops.
+               A replica that fails warmup never serves the new version
+               (registry guarantees its default did not move).
+
+HTTP surface: POST /predict and /generate (proxied, same wire contract
+as the engine — streaming /generate chunks re-framed through), GET
+/health (200 iff >= 1 routable replica; per-replica states), GET
+/metrics (router ledger JSON; Prometheus via the central registry like
+the engine), GET /replicas, POST /rollout.
+
+Env knobs (ops/env.py): DL4J_TPU_SERVE_ROUTER_PORT (0 = ephemeral),
+DL4J_TPU_SERVE_REPLICA_FAILS (consecutive connect/5xx failures that
+eject a replica; 0 disables replica breakers). Fault injection is
+config-driven and never ambient: resilience/chaos.RouterChaosConfig.
+"""
+
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import math
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from deeplearning4j_tpu.obs import journal as obs_journal
+from deeplearning4j_tpu.obs import registry as obs_registry
+from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.obs.exporter import PROMETHEUS_CONTENT_TYPE
+from deeplearning4j_tpu.ops import env as envknob
+from deeplearning4j_tpu.serving.resilience import (
+    BreakerOpenError,
+    CircuitBreaker,
+)
+from deeplearning4j_tpu.serving.slo import parse_slo_classes
+
+
+def replica_fails_default() -> int:
+    return int(envknob.get_int("DL4J_TPU_SERVE_REPLICA_FAILS", 3))
+
+
+def router_port_default() -> int:
+    return int(envknob.get_int("DL4J_TPU_SERVE_ROUTER_PORT", 0))
+
+
+# ---------------------------------------------------------------------------
+# Replica address files (the data half of the membership board: the
+# heartbeat file proves liveness, the addr file says where to connect)
+# ---------------------------------------------------------------------------
+
+
+def _addr_path(root: str, replica_id: str) -> str:
+    return os.path.join(root, f"replica-{replica_id}.addr")
+
+
+def publish_replica_addr(root: str, replica_id: str, url: str) -> None:
+    """Atomic addr publish (tmp + os.replace — the board's own idiom): a
+    router reading mid-write must see the old addr or the new one, never
+    half a JSON."""
+    path = _addr_path(root, replica_id)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"url": url, "pid": os.getpid()}, f)
+    os.replace(tmp, path)
+
+
+def read_replica_addr(root: str, replica_id: str) -> Optional[str]:
+    try:
+        with open(_addr_path(root, replica_id), encoding="utf-8") as f:
+            return str(json.load(f)["url"])
+    except (OSError, ValueError, KeyError):
+        return None  # not published yet (join race) or mid-removal
+
+
+def remove_replica_addr(root: str, replica_id: str) -> None:
+    try:
+        os.remove(_addr_path(root, replica_id))
+    except FileNotFoundError:
+        pass
+
+
+class RouterStats:
+    """Thread-safe router counters + latency reservoir — the fleet-level
+    ledger, registered in the central MetricsRegistry exactly like the
+    engine's ``serving_stats`` (the reference route had no metrics at
+    all; see serving/telemetry.py). Doubles as the replica breakers'
+    stats sink: the breaker's ``record_breaker_*`` / ``record_fast_fail``
+    hooks land in the fleet counters here."""
+
+    def __init__(self, window: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._lat: List[float] = []
+        self._window = int(window)
+        self.requests = 0            # requests admitted for proxying
+        self.proxied_ok = 0          # answered 2xx by some replica
+        self.retries = 0             # re-sends after a replica failure
+        self.replica_failures = 0    # connect-level failures observed
+        self.not_ready_skips = 0     # candidates skipped: not ready
+        self.fleet_429 = 0           # fleet-wide overload sheds
+        self.shed_by_class: Dict[str, int] = {}
+        self.membership_fallbacks = 0  # board unreadable: kept last-known
+        self.replicas_joined = 0
+        self.replicas_left = 0
+        self.rollouts = 0            # completed rolling rollouts
+        self.rollbacks = 0           # rollouts auto-rolled back
+        # replica-breaker plane (CircuitBreaker stats hooks)
+        self.breaker_opens = 0       # replicas ejected
+        self.breaker_closes = 0      # half-open probes that re-admitted
+        self.breaker_probes = 0
+        self.fast_fails_503 = 0      # candidates skipped by open breaker
+
+    # -- recording --------------------------------------------------------
+    def record_request(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_proxied(self, seconds: float) -> None:
+        with self._lock:
+            self.proxied_ok += 1
+            self._lat.append(float(seconds))
+            if len(self._lat) > self._window:
+                del self._lat[:len(self._lat) - self._window]
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def record_replica_failure(self) -> None:
+        with self._lock:
+            self.replica_failures += 1
+
+    def record_not_ready_skip(self) -> None:
+        with self._lock:
+            self.not_ready_skips += 1
+
+    def record_shed(self, slo_class: str) -> None:
+        with self._lock:
+            self.fleet_429 += 1
+            self.shed_by_class[slo_class] = \
+                self.shed_by_class.get(slo_class, 0) + 1
+
+    def record_membership_fallback(self) -> None:
+        with self._lock:
+            self.membership_fallbacks += 1
+
+    def record_join(self) -> None:
+        with self._lock:
+            self.replicas_joined += 1
+
+    def record_leave(self) -> None:
+        with self._lock:
+            self.replicas_left += 1
+
+    def record_rollout(self, rolled_back: bool) -> None:
+        with self._lock:
+            if rolled_back:
+                self.rollbacks += 1
+            else:
+                self.rollouts += 1
+
+    # -- CircuitBreaker stats-sink surface --------------------------------
+    def record_breaker_open(self) -> None:
+        with self._lock:
+            self.breaker_opens += 1
+
+    def record_breaker_close(self) -> None:
+        with self._lock:
+            self.breaker_closes += 1
+
+    def record_breaker_probe(self) -> None:
+        with self._lock:
+            self.breaker_probes += 1
+
+    def record_fast_fail(self) -> None:
+        with self._lock:
+            self.fast_fails_503 += 1
+
+    # -- reading ----------------------------------------------------------
+    def latency_ms(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            # graftlint: disable=host-sync-under-lock -- self._lat is a host-side list of floats; no device buffer ever enters this ring
+            lat = np.asarray(self._lat, np.float64)
+        if lat.size == 0:
+            return {"p50": None, "p95": None, "p99": None, "count": 0}
+        return {
+            "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p95": round(float(np.percentile(lat, 95)) * 1e3, 3),
+            "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+            "count": int(lat.size),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        lat = self.latency_ms()
+        with self._lock:
+            out = {
+                "requests": self.requests,
+                "proxied_ok": self.proxied_ok,
+                "retries": self.retries,
+                "replica_failures": self.replica_failures,
+                "not_ready_skips": self.not_ready_skips,
+                "fleet_429": self.fleet_429,
+                "shed_by_class": dict(self.shed_by_class),
+                "membership_fallbacks": self.membership_fallbacks,
+                "replicas_joined": self.replicas_joined,
+                "replicas_left": self.replicas_left,
+                "rollouts": self.rollouts,
+                "rollbacks": self.rollbacks,
+                "breaker_opens": self.breaker_opens,
+                "breaker_closes": self.breaker_closes,
+                "breaker_probes": self.breaker_probes,
+                "fast_fails_503": self.fast_fails_503,
+            }
+        out["latency_ms"] = lat
+        return out
+
+
+class _Replica:
+    """Router-side view of one replica: address, readiness verdict from
+    the poll, and the replica-level breaker fed by the request path."""
+
+    def __init__(self, rid: str, url: str, breaker: CircuitBreaker):
+        self.rid = rid
+        self.url = url
+        self.breaker = breaker
+        self.ready = True  # optimistic until the first probe says no
+
+    def describe(self) -> Dict[str, Any]:
+        return {"url": self.url, "ready": self.ready,
+                "breaker": self.breaker.snapshot()}
+
+
+class FleetRouterError(RuntimeError):
+    """No routable replica could answer: every candidate was not-ready,
+    ejected, or failed. The HTTP layer answers 503 + Retry-After."""
+
+    retry_after_s = 1.0
+
+
+class FleetOverloadError(RuntimeError):
+    """Fleet-wide SLO shed: the in-flight cap left no headroom for this
+    request's class. 429 + Retry-After."""
+
+
+class _PassThrough(Exception):
+    """A replica answered with a status the router must relay verbatim
+    (4xx client errors, 504 deadline spent, or the last 5xx once every
+    survivor was tried)."""
+
+    def __init__(self, status: int, headers: Dict[str, str], body: bytes):
+        super().__init__(f"replica answered {status}")
+        self.status = int(status)
+        self.headers = dict(headers)
+        self.body = body
+
+
+class FleetRouter:
+    """See module docstring. ``replicas`` pins a static table
+    ({id: url}) for board-less tests; ``fleet_dir`` points at a
+    FileMembershipBoard directory and makes membership dynamic. The
+    optional ``chaos`` is a resilience/chaos.RouterChaos — its
+    kill-replica decision is enacted through ``on_kill`` (the fleet's
+    hook), never by the router itself."""
+
+    # response headers the proxy relays (hop-by-hop framing headers are
+    # the router's own business)
+    _RELAY_HEADERS = ("Content-Type", "Retry-After")
+
+    def __init__(self, *, replicas: Optional[Dict[str, str]] = None,
+                 fleet_dir: Optional[str] = None,
+                 board=None,
+                 port: Optional[int] = None,
+                 replica_fails: Optional[int] = None,
+                 breaker_cooldown_s: float = 1.0,
+                 poll_s: float = 0.25,
+                 probe_timeout_s: float = 2.0,
+                 request_timeout_s: Optional[float] = None,
+                 queue_cap: Optional[int] = None,
+                 slo_classes: Optional[str] = None,
+                 chaos=None,
+                 on_kill: Optional[Callable[[str], None]] = None) -> None:
+        self.replica_fails = int(replica_fails if replica_fails is not None
+                                 else replica_fails_default())
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.poll_s = float(poll_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.request_timeout_s = float(
+            request_timeout_s if request_timeout_s is not None
+            else envknob.get_float("DL4J_TPU_SERVE_TIMEOUT_S", 60))
+        self.queue_cap = int(queue_cap if queue_cap is not None
+                             else envknob.get_int(
+                                 "DL4J_TPU_SERVE_QUEUE_CAP", 512))
+        self.slo_classes = parse_slo_classes(
+            slo_classes if slo_classes is not None
+            else envknob.raw("DL4J_TPU_SERVE_SLO_CLASSES", ""))
+        self.chaos = chaos
+        self.on_kill = on_kill
+        self.stats = RouterStats()
+        obs_registry.default_registry().register_ledger(
+            self, "router_stats", self.stats)
+        self.fleet_dir = fleet_dir
+        if board is None and fleet_dir is not None:
+            from deeplearning4j_tpu.parallel.fleet import FileMembershipBoard
+
+            board = FileMembershipBoard(fleet_dir)
+        self.board = board
+        if board is not None and fleet_dir is None:
+            self.fleet_dir = board.root
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._rr = itertools.count()
+        self._inflight = 0
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        for rid, url in sorted((replicas or {}).items()):
+            self._add_replica(rid, url)
+        router_port = int(port if port is not None else router_port_default())
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", router_port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # -- membership + readiness (poll thread) -----------------------------
+    def _add_replica(self, rid: str, url: str) -> None:
+        def on_transition(old, new, reason, _rid=rid):
+            obs_journal.event("fleet.replica_health", replica=_rid,
+                              old=old, new=new, reason=reason)
+
+        breaker = CircuitBreaker(
+            fails=self.replica_fails, cooldown_s=self.breaker_cooldown_s,
+            key=f"replica:{rid}", stats=self.stats,
+            on_transition=on_transition)
+        with self._lock:
+            self._replicas[rid] = _Replica(rid, url, breaker)
+        self.stats.record_join()
+        obs_journal.event("fleet.replica_join", replica=rid, url=url)
+
+    def _remove_replica(self, rid: str) -> None:
+        with self._lock:
+            gone = self._replicas.pop(rid, None)
+        if gone is not None:
+            self.stats.record_leave()
+            obs_journal.event("fleet.replica_leave", replica=rid)
+
+    def refresh(self) -> None:
+        """One membership + readiness pass (the poll thread's body; tests
+        call it directly for a deterministic table)."""
+        if self.board is not None:
+            try:
+                live = set(self.board.live_workers())
+            except ConnectionError:
+                # board unreadable: a shared-mount blip is a PARTITION —
+                # keep routing over last-known membership (the request
+                # path's breakers still catch truly dead replicas)
+                self.stats.record_membership_fallback()
+                live = None
+            if live is not None:
+                with self._lock:
+                    known = set(self._replicas)
+                for rid in sorted(live - known):
+                    url = read_replica_addr(self.fleet_dir, rid)
+                    if url is not None:  # addr lags the heartbeat briefly
+                        self._add_replica(rid, url)
+                for rid in sorted(known - live):
+                    self._remove_replica(rid)
+                # a restarted replica re-publishes its addr (new port)
+                # BEFORE the corpse's heartbeat ever expired: that's a
+                # NEW incarnation, and the old breaker's verdict belongs
+                # to the dead process — re-join FRESH so the restart is
+                # routable as soon as it probes ready, instead of
+                # waiting broken for request traffic to half-open it
+                for rid in sorted(live & known):
+                    url = read_replica_addr(self.fleet_dir, rid)
+                    if url is None:
+                        continue
+                    with self._lock:
+                        rep = self._replicas.get(rid)
+                        changed = rep is not None and rep.url != url
+                    if changed:
+                        self._remove_replica(rid)
+                        self._add_replica(rid, url)
+        for rep in self._snapshot():
+            self._probe_ready(rep)
+
+    def _probe_ready(self, rep: _Replica) -> None:
+        """Readiness probe: sets ``ready`` ONLY — never a breaker vote.
+        An answered 503 is a draining/broken replica (alive); a connect
+        failure leaves readiness False and lets the board expiry / the
+        request path's breaker handle death (a health blip alone must
+        not eject)."""
+        try:
+            status, _, _ = _http_call(rep.url, "GET", "/health?ready=1",
+                                      timeout=self.probe_timeout_s)
+        except OSError:
+            rep.ready = False
+            return
+        rep.ready = status == 200
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.refresh()
+
+    def _snapshot(self) -> List[_Replica]:
+        with self._lock:
+            return [self._replicas[rid] for rid in sorted(self._replicas)]
+
+    # -- SLO admission -----------------------------------------------------
+    def _class_of(self, payload) -> tuple:
+        """(name, priority) of the request's SLO class. Unlabeled
+        requests and unknown names ride the LOWEST class: under overload
+        the router sheds what it cannot rank."""
+        n = len(self.slo_classes)
+        if n == 0:
+            return "default", 0
+        name = payload.get("slo") if isinstance(payload, dict) else None
+        for c in self.slo_classes:
+            if c.name == name:
+                return c.name, c.priority
+        return (name if isinstance(name, str)
+                else self.slo_classes[-1].name), n - 1
+
+    def _admit(self, payload) -> str:
+        """Fleet-wide SLO shed: class priority p of n gets the in-flight
+        headroom ``cap * (n - p) / n`` — the highest class keeps the full
+        cap while lower classes shed progressively earlier. Returns the
+        class name; the caller MUST pair with :meth:`_release`."""
+        name, priority = self._class_of(payload)
+        n = max(1, len(self.slo_classes))
+        cap = max(1, math.ceil(self.queue_cap * (n - priority) / n))
+        with self._lock:
+            if self._inflight >= cap:
+                shed = True
+            else:
+                shed = False
+                self._inflight += 1
+        if shed:
+            self.stats.record_shed(name)
+            raise FleetOverloadError(
+                f"fleet overload: class {name!r} shed at in-flight cap "
+                f"{cap} (queue_cap {self.queue_cap})")
+        self.stats.record_request()
+        return name
+
+    def _release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    # -- routing -----------------------------------------------------------
+    def _candidates(self) -> List[_Replica]:
+        reps = self._snapshot()
+        ready = []
+        for rep in reps:
+            if rep.ready:
+                ready.append(rep)
+            else:
+                self.stats.record_not_ready_skip()
+        if not ready:
+            return []
+        start = next(self._rr) % len(ready)
+        return ready[start:] + ready[:start]
+
+    def _after_proxy(self) -> None:
+        """Chaos hook: after each completed proxy ask the configured
+        RouterChaos whether a replica dies NOW; the fleet's on_kill
+        enacts it (the router never owns replica processes)."""
+        if self.chaos is None:
+            return
+        victim = self.chaos.kill_due()
+        if victim is not None and self.on_kill is not None:
+            self.on_kill(victim)
+
+    def _proxy_once(self, rep: _Replica, method: str, path: str,
+                    body: bytes) -> tuple:
+        if self.chaos is not None:
+            self.chaos.on_replica_call(rep.rid)
+        return _http_call(rep.url, method, path, body=body,
+                          timeout=self.request_timeout_s)
+
+    def proxy_predict(self, body: bytes) -> tuple:
+        """Route one idempotent /predict across the fleet: walk ready
+        candidates round-robin; a connect failure or 5xx votes the
+        replica's breaker and RETRIES on the next survivor (429/503
+        retried without a vote — backpressure and drain are not
+        death); 4xx/504 relay immediately. Returns (status, headers,
+        body) of the winning response; raises FleetRouterError when no
+        candidate answered."""
+        payload = _parse_json(body)
+        self._admit(payload)
+        try:
+            with obs_trace.span("fleet.route", kind="predict"):
+                return self._walk_predict(body)
+        finally:
+            self._release()
+            self._after_proxy()
+
+    def _walk_predict(self, body: bytes) -> tuple:
+        last_response: Optional[tuple] = None
+        tried = 0
+        for rep in self._candidates():
+            try:
+                rep.breaker.check()
+            except BreakerOpenError:
+                continue  # ejected; fast_fails_503 counted by the breaker
+            if tried:
+                self.stats.record_retry()
+            tried += 1
+            try:
+                status, headers, data = self._proxy_once(
+                    rep, "POST", "/predict", body)
+            except OSError as e:
+                # connection-level failure: the replica (or the path to
+                # it) is gone mid-request — vote and retry the admitted
+                # work on a survivor; nothing was lost
+                self.stats.record_replica_failure()
+                rep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                continue
+            if status < 400:
+                rep.breaker.record_success()
+                return status, headers, data
+            if status in (429, 503):
+                # honest backpressure/drain from a live replica: not a
+                # health vote (the probe, if this was one, stays
+                # unresolved and its TTL re-grants), but another replica
+                # may still have room — keep walking
+                last_response = (status, headers, data)
+                continue
+            if status == 504:
+                # the request's OWN deadline expired at the replica:
+                # retrying would double-spend a budget that is already
+                # gone, and a timeout is deadline evidence, not death
+                return status, headers, data
+            if status >= 500:
+                rep.breaker.record_failure(f"HTTP {status}")
+                last_response = (status, headers, data)
+                continue
+            # 4xx: the request itself is the problem — relay verbatim;
+            # the replica ANSWERED, which resolves a granted probe
+            rep.breaker.record_success()
+            return status, headers, data
+        if last_response is not None:
+            return last_response
+        raise FleetRouterError("no routable replica (all not-ready, "
+                               "ejected, or failed)")
+
+    def proxy_generate(self, body: bytes) -> tuple:
+        """Route one /generate: same candidate walk, but retry ONLY on a
+        connect-phase failure (no bytes exchanged — sampling must never
+        run twice for one request). Streaming requests are answered
+        non-streamed by this method's caller contract; the HTTP layer
+        uses :meth:`proxy_generate_stream` for ``"stream": true``."""
+        payload = _parse_json(body)
+        self._admit(payload)
+        try:
+            with obs_trace.span("fleet.route", kind="generate"):
+                return self._walk_generate(body)
+        finally:
+            self._release()
+            self._after_proxy()
+
+    def _walk_generate(self, body: bytes) -> tuple:
+        last_response: Optional[tuple] = None
+        for rep in self._candidates():
+            try:
+                rep.breaker.check()
+            except BreakerOpenError:
+                continue
+            if self.chaos is not None:
+                try:
+                    self.chaos.on_replica_call(rep.rid)
+                except ConnectionError as e:
+                    self.stats.record_replica_failure()
+                    rep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                    continue
+            u = urlsplit(rep.url)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=self.request_timeout_s)
+            try:
+                try:
+                    conn.connect()
+                except OSError as e:
+                    # connect phase: nothing sent — safe to try a survivor
+                    self.stats.record_replica_failure()
+                    rep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                    continue
+                # bytes are about to flow: from here the request is
+                # committed to THIS replica (no retry — the sample may
+                # already be burning seed state)
+                conn.request("POST", "/generate", body=body, headers={
+                    "Content-Type": "application/json",
+                    "Content-Length": str(len(body))})
+                resp = conn.getresponse()
+                data = resp.read()
+                status = int(resp.status)
+                headers = {k: v for k, v in resp.getheaders()
+                           if k in self._RELAY_HEADERS}
+            finally:
+                conn.close()
+            if status < 400:
+                rep.breaker.record_success()
+                return status, headers, data
+            if status in (429, 503):
+                last_response = (status, headers, data)
+                continue
+            if status == 504:
+                return status, headers, data  # deadline, not death
+            if status >= 500:
+                # committed to this replica (bytes flowed): relay the
+                # failure rather than re-running a stateful sample
+                rep.breaker.record_failure(f"HTTP {status}")
+                return status, headers, data
+            rep.breaker.record_success()
+            return status, headers, data
+        if last_response is not None:
+            return last_response
+        raise FleetRouterError("no routable replica (all not-ready, "
+                               "ejected, or failed)")
+
+    # -- rolling rollout ---------------------------------------------------
+    def rollout(self, name: str, path: str, *,
+                input_shape=None, max_batch: Optional[int] = None,
+                gen_tokens: int = 0) -> Dict[str, Any]:
+        """Rolling model rollout across the fleet, one replica at a time:
+        load -> warmup (the bucket ladder compiles BEFORE traffic — the
+        registry's warmup contract) -> serve, in replica order. Any
+        load/warmup/serve failure stops the roll and AUTO-ROLLS BACK the
+        replicas already shifted (re-serving their recorded prior
+        default); the failing replica's own default never moved — the
+        registry's load/warmup isolation, now fleet-scoped. Returns a
+        report dict; ``ok`` is False on rollback."""
+        reps = self._snapshot()
+        if not reps:
+            raise FleetRouterError("rollout with no replicas")
+        shifted: List[tuple] = []  # (rep, prior_name, prior_version)
+        report: Dict[str, Any] = {"ok": True, "model": name,
+                                  "replicas": [], "rolled_back": []}
+        for rep in reps:
+            prior = self._serving_default(rep)
+            err = self._roll_one(rep, name, path, input_shape,
+                                 max_batch, gen_tokens)
+            if err is None:
+                shifted.append((rep, prior))
+                report["replicas"].append(rep.rid)
+                obs_journal.event("fleet.rollout_step", replica=rep.rid,
+                                  model=name)
+                continue
+            # failed mid-roll: the failing replica's default is intact
+            # (registry isolation); un-shift everyone already moved
+            for done_rep, done_prior in shifted:
+                if done_prior is not None:
+                    self._serve_version(done_rep, *done_prior)
+                    report["rolled_back"].append(done_rep.rid)
+            report.update(ok=False, failed_replica=rep.rid, error=err)
+            self.stats.record_rollout(rolled_back=True)
+            obs_journal.event("fleet.rollout_rollback", replica=rep.rid,
+                              model=name, error=err)
+            return report
+        self.stats.record_rollout(rolled_back=False)
+        obs_journal.event("fleet.rollout_complete", model=name,
+                          replicas=len(reps))
+        return report
+
+    def _roll_one(self, rep: _Replica, name, path, input_shape,
+                  max_batch, gen_tokens) -> Optional[str]:
+        """load+warmup+serve on one replica via its public /models API.
+        Returns an error string (first failing step) or None."""
+        steps = [
+            {"action": "load", "name": name, "path": path,
+             "input_shape": input_shape},
+            {"action": "warmup", "name": name,
+             **({"max_batch": int(max_batch)} if max_batch else {}),
+             "gen_tokens": int(gen_tokens)},
+            {"action": "serve", "name": name},
+        ]
+        for step in steps:
+            try:
+                status, _, data = _http_call(
+                    rep.url, "POST", "/models",
+                    body=json.dumps(step).encode(),
+                    timeout=max(self.request_timeout_s, 60.0))
+            except OSError as e:
+                return f"{step['action']}: {type(e).__name__}: {e}"
+            if status != 200:
+                return (f"{step['action']}: HTTP {status}: "
+                        f"{data[:200].decode(errors='replace')}")
+        return None
+
+    def _serving_default(self, rep: _Replica) -> Optional[tuple]:
+        """(name, version) currently served by default on a replica, read
+        through its public /models listing."""
+        try:
+            status, _, data = _http_call(rep.url, "GET", "/models",
+                                         timeout=self.probe_timeout_s)
+        except OSError:
+            return None
+        if status != 200:
+            return None
+        key = json.loads(data).get("default")
+        if not key or "@v" not in key:
+            return None
+        name, _, version = key.rpartition("@v")
+        try:
+            return name, int(version)
+        except ValueError:
+            return None
+
+    def _serve_version(self, rep: _Replica, name: str, version: int) -> None:
+        try:
+            _http_call(rep.url, "POST", "/models",
+                       body=json.dumps({"action": "serve", "name": name,
+                                        "version": version}).encode(),
+                       timeout=self.probe_timeout_s)
+        except OSError:
+            pass  # the replica died mid-rollback; membership will notice
+
+    # -- introspection -----------------------------------------------------
+    def describe_replicas(self) -> Dict[str, Any]:
+        return {rep.rid: rep.describe() for rep in self._snapshot()}
+
+    def health(self) -> tuple:
+        """(http_code, body): 200 iff at least one replica is routable
+        (ready + breaker not open) — the fleet-level twin of the
+        engine's honest /health."""
+        desc = self.describe_replicas()
+        routable = [rid for rid, d in desc.items()
+                    if d["ready"] and d["breaker"]["state"] != "broken"]
+        body = {"ok": bool(routable), "routable": routable,
+                "replicas": desc}
+        return (200 if routable else 503), body
+
+    def metrics(self) -> Dict[str, Any]:
+        return {"router": self.stats.snapshot(),
+                "replicas": self.describe_replicas()}
+
+    # -- HTTP --------------------------------------------------------------
+    def _make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_raw(self, code: int, headers: Dict[str, str],
+                          body: bytes):
+                self.send_response(code)
+                ct = headers.get("Content-Type", "application/json")
+                self.send_header("Content-Type", ct)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers.items():
+                    if k in ("Content-Type",):
+                        continue
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/health":
+                    code, body = router.health()
+                    self._send(code, body)
+                elif path == "/replicas":
+                    self._send(200, router.describe_replicas())
+                elif path == "/metrics":
+                    accept = self.headers.get("Accept", "")
+                    if ("format=prometheus" in self.path
+                            or "text/plain" in accept
+                            or "openmetrics" in accept):
+                        body = (obs_registry.default_registry()
+                                .render_prometheus().encode())
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         PROMETHEUS_CONTENT_TYPE)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._send(200, router.metrics())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                start = time.monotonic()
+                try:
+                    if self.path == "/predict":
+                        body = self._read_body()
+                        status, headers, data = router.proxy_predict(body)
+                    elif self.path == "/generate":
+                        body = self._read_body()
+                        if _parse_json(body).get("stream"):
+                            self._stream_generate(body)
+                            return
+                        status, headers, data = router.proxy_generate(body)
+                    elif self.path == "/rollout":
+                        payload = json.loads(self._read_body())
+                        report = router.rollout(
+                            payload["name"], payload["path"],
+                            input_shape=payload.get("input_shape"),
+                            max_batch=payload.get("max_batch"),
+                            gen_tokens=int(payload.get("gen_tokens", 0)))
+                        self._send(200 if report["ok"] else 409, report)
+                        return
+                    else:
+                        self._send(404, {"error": "not found"})
+                        return
+                except FleetOverloadError as e:
+                    self._send(429, {"error": f"{e}"},
+                               headers={"Retry-After": "1"})
+                    return
+                except FleetRouterError as e:
+                    self._send(503, {"error": f"{e}"},
+                               headers={"Retry-After": str(max(
+                                   1, math.ceil(e.retry_after_s)))})
+                    return
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if status < 400:
+                    router.stats.record_proxied(time.monotonic() - start)
+                self._send_raw(status, headers, data)
+
+            def _stream_generate(self, body: bytes):
+                """Streamed /generate: committed to ONE replica once the
+                response begins; chunks re-framed through verbatim."""
+                try:
+                    router._admit(_parse_json(body))
+                except FleetOverloadError as e:
+                    self._send(429, {"error": f"{e}"},
+                               headers={"Retry-After": "1"})
+                    return
+                try:
+                    router._stream_through(self, body)
+                finally:
+                    router._release()
+                    router._after_proxy()
+
+        return Handler
+
+    def _stream_through(self, handler, body: bytes) -> None:
+        """Proxy a streaming /generate to the first replica that ACCEPTS
+        it (connect + response headers); after that the stream is
+        committed (a half-relayed token stream cannot be replayed)."""
+        for rep in self._candidates():
+            try:
+                rep.breaker.check()
+            except BreakerOpenError:
+                continue
+            if self.chaos is not None:
+                try:
+                    self.chaos.on_replica_call(rep.rid)
+                except ConnectionError as e:
+                    self.stats.record_replica_failure()
+                    rep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                    continue
+            u = urlsplit(rep.url)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=self.request_timeout_s)
+            try:
+                try:
+                    conn.connect()
+                    conn.request("POST", "/generate", body=body, headers={
+                        "Content-Type": "application/json",
+                        "Content-Length": str(len(body))})
+                    resp = conn.getresponse()
+                except OSError as e:
+                    self.stats.record_replica_failure()
+                    rep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                    continue
+                start = time.monotonic()
+                if resp.status != 200:
+                    data = resp.read()
+                    handler._send_raw(resp.status, {
+                        k: v for k, v in resp.getheaders()
+                        if k in self._RELAY_HEADERS}, data)
+                    if resp.status in (429, 503):
+                        return  # backpressure relayed; no vote
+                    if resp.status >= 500:
+                        rep.breaker.record_failure(f"HTTP {resp.status}")
+                    else:
+                        rep.breaker.record_success()
+                    return
+                handler.send_response(200)
+                handler.send_header("Content-Type",
+                                    resp.getheader("Content-Type",
+                                                   "application/x-ndjson"))
+                handler.send_header("Transfer-Encoding", "chunked")
+                handler.end_headers()
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    handler.wfile.write(b"%x\r\n" % len(line) + line
+                                        + b"\r\n")
+                    handler.wfile.flush()
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+                rep.breaker.record_success()
+                self.stats.record_proxied(time.monotonic() - start)
+            finally:
+                conn.close()
+            return
+        handler._send(503, {"error": "no routable replica"},
+                      headers={"Retry-After": "1"})
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        self.refresh()  # a synchronous first pass: routable immediately
+        self._poll_thread = threading.Thread(target=self._poll_loop,
+                                             daemon=True,
+                                             name="fleet-router-poll")
+        self._poll_thread.start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="fleet-router-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5)
+
+
+def _parse_json(body: bytes):
+    try:
+        return json.loads(body)
+    except ValueError:
+        return {}
+
+
+def _http_call(url: str, method: str, path: str, body: Optional[bytes] = None,
+               timeout: float = 30.0) -> tuple:
+    """One HTTP exchange with a replica: (status, relay-headers, body).
+    Connection-level failures surface as OSError (the caller's breaker
+    evidence); an answered response NEVER raises."""
+    u = urlsplit(url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    try:
+        headers = {}
+        if body is not None:
+            headers = {"Content-Type": "application/json",
+                       "Content-Length": str(len(body))}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        data = resp.read()
+        relay = {k: v for k, v in resp.getheaders()
+                 if k in FleetRouter._RELAY_HEADERS}
+        return int(resp.status), relay, data
+    finally:
+        conn.close()

@@ -32,7 +32,8 @@ EXPECTED = [
     "remat_memory", "char_rnn", "word2vec_sgns", "transformer_lm",
     "resnet50", "resnet50_bf16", "transformer_lm_big", "flash_attention",
     "ring_attention", "lstm_kernel", "north_star", "serving_throughput",
-    "serving_resilience", "serving_decode", "checkpoint_overhead",
+    "serving_resilience", "serving_decode", "serving_fleet",
+    "checkpoint_overhead",
     "input_pipeline",
     "elastic_dp", "obs_overhead",
     "reference_cpu_lenet5_torch", "lenet5_cpu",

@@ -88,6 +88,13 @@ _QUICK_FILES = {
     # (solo==coscheduled across prefix sharing/preemption), crash
     # eviction, SLO shed, streaming, arena sizing — ~15s on tiny LMs
     "test_serving_paged.py",
+    # serving fleet (ISSUE 12): router+replicas byte-identity vs a solo
+    # engine, chaos-killed replica => zero failed admitted requests,
+    # rollout auto-rollback never moving a serving default, fleet-wide
+    # SLO shed, breaker eject/half-open re-admit — deterministic chaos
+    # on tiny nets, in-process replicas (~20s); OS-process replicas are
+    # full tier (test_serving_fleet_process.py)
+    "test_serving_fleet.py",
     # graftlint (ISSUE 10): per-rule fixture contracts + the repo-wide
     # clean sweep + the knob-table↔CLAUDE.md consistency gate — pure-AST,
     # jax-free, seconds for the fixtures and ~15s for the sweep
