@@ -192,4 +192,56 @@ class DocstringProvenance(Rule):
         return findings
 
 
-RULES = (LedgerRegistration, SignalHandlerSafety, DocstringProvenance)
+# ---------------------------------------------------------------------------
+# pallas rent
+# ---------------------------------------------------------------------------
+
+#: the sanctioned home for pallas kernels (the CLAUDE.md rent rule: VMEM
+#: shape-gating, XLA fallback, interpret-mode CPU tests, and a
+#: PALLAS_BENCH.json row all live next to the kernel)
+_PALLAS_HOME_RE = re.compile(r"^deeplearning4j_tpu/ops/pallas_[^/]+\.py$")
+
+
+class PallasRent(Rule):
+    name = "pallas-rent"
+    severity = "error"
+    doc = ("pl.pallas_call outside ops/pallas_*.py, or a pallas module "
+           "with no interpret= fallback parameter — every kernel must "
+           "live where its rent contract (shape gate, XLA fallback, "
+           "interpret-mode CPU tests, measured-win row) is enforced")
+
+    def check(self, parsed: ParsedFile) -> List[Finding]:
+        rel = parsed.rel.replace(os.sep, "/")
+        calls = [node for node in ast.walk(parsed.tree)
+                 if isinstance(node, ast.Call)
+                 and (call_name(node) or "").split(".")[-1] == "pallas_call"]
+        if not calls:
+            return []
+        if not _PALLAS_HOME_RE.match(rel):
+            return [self.finding(
+                parsed, node,
+                "pl.pallas_call outside ops/pallas_*.py — kernels pay "
+                "rent (shape gate + fallback + interpret tests + "
+                "PALLAS_BENCH row) in their own ops/pallas_* module; "
+                "call the module's public wrapper instead")
+                for node in calls]
+        # in the sanctioned home: the module must expose the interpret=
+        # escape hatch somewhere (a def parameter), or the CPU substrate
+        # has no way to exercise the kernel (Mosaic only compiles on chip)
+        for node in ast.walk(parsed.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                names = [a.arg for a in (args.posonlyargs + args.args
+                                         + args.kwonlyargs)]
+                if "interpret" in names:
+                    return []
+        return [self.finding(
+            parsed, calls[0],
+            "pallas module defines no function with an interpret= "
+            "parameter — without the interpret-mode fallback the kernel "
+            "cannot be exercised on the CPU substrate (the rent "
+            "contract's test leg)")]
+
+
+RULES = (LedgerRegistration, SignalHandlerSafety, DocstringProvenance,
+         PallasRent)

@@ -157,16 +157,22 @@ _skipgram_hs_step = functools.partial(jax.jit, donate_argnums=(0, 1))(_hs_body)
 
 # graftlint: disable=donation-through-dispatch -- functional-update idiom predating ops/dispatch: every caller rebinds to the returned tables and never re-reads the donated args (the no-re-read contract is structural at each call site)
 @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
-                   static_argnames=("use_neg", "negative_k"))
+                   static_argnames=("use_neg", "negative_k",
+                                    "sgns_kernel", "sgns_interpret"))
 def _skipgram_epoch(syn0, syn1, syn1neg, P, C, M, table, cens, cxs,
-                    pair_live, keys, alphas, *, use_neg, negative_k):
+                    pair_live, keys, alphas, *, use_neg, negative_k,
+                    sgns_kernel=False, sgns_interpret=False):
     """Scan over stacked skip-gram batches.
 
     cens/cxs: [NB, B] int32; pair_live: [NB, B] (0 for padding);
     keys: [NB] uint32 PRNG keys — negatives are drawn ON DEVICE from the
     device-resident unigram `table` (shipping pre-drawn [NB, B, K+1]
     targets/labels/live costs ~75 MB per chunk through the runtime;
-    drawing device-side moves only the key); alphas: [NB] per-batch LR."""
+    drawing device-side moves only the key); alphas: [NB] per-batch LR.
+    sgns_kernel (static, resolved by the caller through
+    ops/pallas_sgns.sgns_kernel_enabled) swaps _neg_body for the fused
+    Pallas gather-dot-scatter step; sgns_interpret rides along for the
+    CPU test substrate."""
 
     def body(carry, inp):
         syn0, syn1, syn1neg = carry
@@ -190,9 +196,17 @@ def _skipgram_epoch(syn0, syn1, syn1neg, P, C, M, table, cens, cxs,
                 ],
                 axis=1,
             )
-            syn0, syn1neg = _neg_body(
-                syn0, syn1neg, cx, tgt, lbl, nlive * plive[:, None], alpha
-            )
+            if sgns_kernel:
+                from deeplearning4j_tpu.ops.pallas_sgns import sgns_fused_step
+
+                syn0, syn1neg = sgns_fused_step(
+                    syn0, syn1neg, cx, tgt, lbl, nlive * plive[:, None],
+                    alpha, interpret=sgns_interpret,
+                )
+            else:
+                syn0, syn1neg = _neg_body(
+                    syn0, syn1neg, cx, tgt, lbl, nlive * plive[:, None], alpha
+                )
         return (syn0, syn1, syn1neg), None
 
     (syn0, syn1, syn1neg), _ = jax.lax.scan(
@@ -485,6 +499,14 @@ class Word2Vec:
                 order = rng.permutation(len(centers))
                 centers, contexts = centers[order], contexts[order]
                 n_ex = len(centers)
+                # kernel-rent gate, resolved once per fit (trace-time
+                # static args — a knob flip recompiles the epoch scan)
+                from deeplearning4j_tpu.ops import pallas_sgns
+
+                sgns_on = use_neg and pallas_sgns.sgns_kernel_enabled(
+                    B, self.negative + 1, syn0.shape[1]
+                )
+                sgns_interp = sgns_on and pallas_sgns.sgns_interpret()
                 nb = max(1, -(-n_ex // B))
                 alphas = np.array(
                     [self._alpha(phase, bi, n_phases, nb) for bi in range(nb)],
@@ -512,6 +534,8 @@ class Word2Vec:
                         jnp.asarray(al),
                         use_neg=use_neg,
                         negative_k=self.negative,
+                        sgns_kernel=sgns_on,
+                        sgns_interpret=sgns_interp,
                     )
 
         lt.syn0 = np.asarray(syn0)

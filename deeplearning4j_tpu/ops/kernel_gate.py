@@ -82,7 +82,17 @@ def record_win(group: str, name: str, row: dict) -> None:
     _merge(lambda data: data.setdefault(group, {}).__setitem__(name, row))
 
 
+def record_verdict(group: str, text: str) -> None:
+    """Record a per-kernel-group verdict under the artifact's ``verdicts``
+    dict. Replaces the legacy single top-level ``verdict`` (which
+    round-boundary archiving would overwrite with whichever kernel bench
+    ran last) — each group keeps its own default-on note."""
+    _merge(lambda data: data.setdefault("verdicts", {}).__setitem__(
+        group, text))
+
+
 def merge_top_level(updates: dict) -> None:
     """Merge top-level keys (the legacy round-1/2 schema: backend / cases /
-    verdict) into the artifact without touching kernel groups."""
+    verdict) into the artifact without touching kernel groups. Kept for
+    archived-artifact tooling; live benches write group rows + verdicts."""
     _merge(lambda data: data.update(updates))

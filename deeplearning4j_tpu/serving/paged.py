@@ -81,6 +81,7 @@ from deeplearning4j_tpu.models.transformer import (
 from deeplearning4j_tpu.obs import trace as obs_trace
 from deeplearning4j_tpu.ops import dispatch
 from deeplearning4j_tpu.ops import memory as opsmem
+from deeplearning4j_tpu.ops import pallas_paged
 from deeplearning4j_tpu.serving.batcher import (
     QueueFullError,
     RequestTimeoutError,
@@ -93,8 +94,20 @@ from deeplearning4j_tpu.serving.slo import SLOClass, default_classes
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
 
+def attention_path(cfg: TransformerConfig, block_tokens: int) -> str:
+    """Which attention path the paged tick traces for this config:
+    ``kernel`` = the pallas paged-decode kernel (ops/pallas_paged.py,
+    behind DL4J_TPU_PALLAS_PAGED + the measured-win gate), ``gather`` =
+    the dense ``ck[tables]`` fallback. Resolved at trace time; the tick
+    cache keys on it, and the serving_decode bench stamps it."""
+    hd = cfg.d_model // cfg.n_heads
+    if pallas_paged.paged_kernel_enabled(cfg.n_heads, hd, block_tokens):
+        return "kernel"
+    return "gather"
+
+
 def paged_decode_step(params, arena, tok, pos, tables,
-                      cfg: TransformerConfig):
+                      cfg: TransformerConfig, attention: Optional[str] = None):
     """One decode tick over the block arena: tok [S] int32, pos [S]
     int32, tables [S, max_len//bt] int32 -> (updated arena, logits
     [S, V]).
@@ -105,11 +118,21 @@ def paged_decode_step(params, arena, tok, pos, tables,
     cache write becomes a scatter into (block, offset) =
     (tables[s, pos//bt], pos % bt). Active lanes write distinct blocks
     by allocation invariant; inactive lanes all scatter into trash
-    block 0, whose content is never visible under the causal mask."""
+    block 0, whose content is never visible under the causal mask.
+
+    ``attention`` picks the per-layer attention body ('kernel' streams
+    blocks through the pallas online-softmax kernel and never
+    materializes the gathered window; 'gather' is the dense fallback;
+    None resolves via attention_path at trace time). Both honor the same
+    ``arange <= pos`` visibility mask, so outputs agree to f32 rounding
+    (tests/test_pallas_paged.py pins 1e-6)."""
     cdt = cfg.compute_dtype
     s = tok.shape[0]
     hd = cfg.d_model // cfg.n_heads
     bt = arena["k"].shape[2]
+    if attention is None:
+        attention = attention_path(cfg, bt)
+    interp = attention == "kernel" and pallas_paged.paged_interpret()
     t_total = tables.shape[1] * bt                    # == cfg.max_len
     h = (params["embed"][tok] + params["pos"][pos])[:, None, :].astype(cdt)
     scale = 1.0 / float(np.sqrt(hd))
@@ -127,14 +150,20 @@ def paged_decode_step(params, arena, tok, pos, tables,
         v1 = (x @ c(bp["Wv"])).reshape(s, cfg.n_heads, hd)
         ck = ck.at[wb, off].set(k1.astype(ck.dtype))
         cv = cv.at[wb, off].set(v1.astype(cv.dtype))
-        kg = ck[tables].reshape(s, t_total, cfg.n_heads, hd)
-        vg = cv[tables].reshape(s, t_total, cfg.n_heads, hd)
-        sc = jnp.einsum("nhd,nthd->nht", q.astype(jnp.float32),
-                        kg.astype(jnp.float32)) * scale
-        sc = jnp.where(visible[:, None, :], sc, -jnp.inf)
-        p = jax.nn.softmax(sc, axis=-1)
-        att = jnp.einsum("nht,nthd->nhd", p,
-                         vg.astype(jnp.float32)).reshape(s, 1, cfg.d_model)
+        if attention == "kernel":
+            att = pallas_paged.paged_attention(
+                q, ck, cv, tables, pos,
+                interpret=interp).reshape(s, 1, cfg.d_model)
+        else:
+            kg = ck[tables].reshape(s, t_total, cfg.n_heads, hd)
+            vg = cv[tables].reshape(s, t_total, cfg.n_heads, hd)
+            sc = jnp.einsum("nhd,nthd->nht", q.astype(jnp.float32),
+                            kg.astype(jnp.float32)) * scale
+            sc = jnp.where(visible[:, None, :], sc, -jnp.inf)
+            p = jax.nn.softmax(sc, axis=-1)
+            att = jnp.einsum(
+                "nht,nthd->nhd", p,
+                vg.astype(jnp.float32)).reshape(s, 1, cfg.d_model)
         h = h + att.astype(cdt) @ c(bp["Wo"])
         x = _ln(h, c(bp["ln2_g"]), c(bp["ln2_b"]))
         h = h + jax.nn.gelu(x @ c(bp["W1"]) + c(bp["b1"])) @ c(bp["W2"]) \
@@ -156,14 +185,19 @@ _PAGED_ADMIT_CACHE: Dict[tuple, object] = {}
 
 
 def _paged_tick_for(cfg: TransformerConfig, block_tokens: int):
-    key = (cfg, block_tokens)
+    # the attention path (and its interpret flag) is resolved HERE, not
+    # inside the trace: a knob flip after the first tick must rebuild the
+    # jitted program, so the resolved path rides the cache key
+    path = attention_path(cfg, block_tokens)
+    key = (cfg, block_tokens, path,
+           path == "kernel" and pallas_paged.paged_interpret())
     fn = _PAGED_TICK_CACHE.get(key)
     if fn is not None:
         return fn
 
     def tick(params, arena, tok, pos, tables, keys, temps):
         arena, logits = paged_decode_step(params, arena, tok, pos, tables,
-                                          cfg)
+                                          cfg, attention=path)
         split = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
         nkeys, subs = split[:, 0], split[:, 1]
         tempered = logits / jnp.maximum(temps, 1e-6)[:, None]

@@ -35,7 +35,7 @@ EXPECTED = [
     "serving_resilience", "serving_decode", "serving_fleet",
     "checkpoint_overhead",
     "input_pipeline",
-    "elastic_dp", "obs_overhead",
+    "elastic_dp", "obs_overhead", "paged_kernel", "sgns_kernel",
     "reference_cpu_lenet5_torch", "lenet5_cpu",
     "char_rnn_cpu", "native_feed", "scaling_virtual8",
 ]
@@ -118,6 +118,39 @@ def warnings(legs: dict) -> list:
     return out
 
 
+_PALLAS_BENCH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "PALLAS_BENCH.json")
+
+
+def kernel_gate_warnings(path: str = None) -> list:
+    """Provenance check on the measured-win artifact (ISSUE 13): a
+    default-on kernel decision must come from a real-chip row. measured_win
+    already IGNORES backend=="cpu"/interpret rows, but their presence in a
+    group means the honest answer for that kernel is still 'unproven' —
+    a summarizer (or a human eyeballing speedup numbers) must not read an
+    interpret-mode timing as chip evidence."""
+    out = []
+    try:
+        with open(path or _PALLAS_BENCH) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return out
+    for group, rows in data.items():
+        if group == "verdicts" or not isinstance(rows, dict):
+            continue
+        for name, row in rows.items():
+            if not isinstance(row, dict) or "speedup" not in row:
+                continue
+            if row.get("backend") == "cpu" or row.get("interpret"):
+                out.append(
+                    f"PALLAS_BENCH {group}.{name}: speedup "
+                    f"{row['speedup']} is a CPU/interpret-mode row — NOT "
+                    "chip evidence; the measured-win gate ignores it and "
+                    f"the {group} kernel stays default-off until a real-"
+                    "chip row lands")
+    return out
+
+
 def main() -> int:
     path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_PARTIAL.json"
     try:
@@ -135,6 +168,8 @@ def main() -> int:
         print("WARN: artifact was produced from a graftlint-DIRTY tree "
               "(run `python -m deeplearning4j_tpu.analysis`)")
     for w in warnings(legs):
+        print("WARN:", w)
+    for w in kernel_gate_warnings():
         print("WARN:", w)
     if missing:
         print("missing/errored legs:", ", ".join(missing))

@@ -99,6 +99,11 @@ _QUICK_FILES = {
     # clean sweep + the knob-table↔CLAUDE.md consistency gate — pure-AST,
     # jax-free, seconds for the fixtures and ~15s for the sweep
     "test_analysis.py",
+    # kernel rent program (ISSUE 13): interpret-mode CPU equivalence for
+    # the paged-decode attention + fused SGNS kernels (value, tick/epoch,
+    # forced-transcript, and gate contracts) — tiny shapes, ~30s
+    "test_pallas_paged.py",
+    "test_pallas_sgns.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and

@@ -204,3 +204,38 @@ def test_elastic_dp_leg_registered():
     assert "elastic_dp" in expected_legs()
     m = _load_bench()
     assert "elastic_dp" in m._CPU_ONLY_LEGS
+
+
+def test_kernel_legs_registered():
+    """ISSUE 13: the paged_kernel / sgns_kernel legs (interpret-mode CPU
+    equivalence when the tunnel is dead, compiled real-chip measured-win
+    rows at contact) are in the expected set AND in bench.py's CPU-only
+    set — the watcher demands an honest row every round either way."""
+    from scripts.bench_state import EXPECTED, expected_legs
+
+    m = _load_bench()
+    for leg in ("paged_kernel", "sgns_kernel"):
+        assert leg in EXPECTED
+        assert leg in expected_legs()
+        assert leg in m._CPU_ONLY_LEGS
+
+
+def test_bench_state_warns_on_interpret_gate_rows(tmp_path):
+    """ISSUE 13: a CPU/interpret-mode row inside PALLAS_BENCH.json gets
+    a WARN naming the kernel (NOT chip evidence; the measured-win gate
+    ignores it) — and a real-chip row stays quiet."""
+    from scripts.bench_state import kernel_gate_warnings
+
+    art = tmp_path / "pallas.json"
+    art.write_text(json.dumps({
+        "paged": {"d8_h16": {"speedup": 3.0, "backend": "cpu",
+                             "interpret": True}},
+        "sgns": {"v100k": {"speedup": 1.4, "backend": "tpu",
+                           "interpret": False}},
+        "verdicts": {"paged": "smoke only"},
+    }))
+    warns = kernel_gate_warnings(str(art))
+    assert len(warns) == 1
+    assert "paged.d8_h16" in warns[0] and "NOT" in warns[0]
+    # the real committed artifact must carry no interpret-mode rows
+    assert kernel_gate_warnings() == []
