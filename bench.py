@@ -2152,6 +2152,169 @@ def bench_elastic_dp(rounds=10, workers=4):
 
 
 # ---------------------------------------------------------------------------
+# online_loop: the full online-learning cycle (ISSUE 14 —
+# deeplearning4j_tpu/online/): streaming ingest -> continuous fit ->
+# candidate export -> shadow stage -> gated promotion, timed per phase,
+# plus the shadow-mirror cost on the /predict answer path (bar < 3%).
+# CPU-only by design: every phase is host-side orchestration (stream
+# buffering, checkpoint commits, registry lifecycle, the offer-path
+# stride) around tiny-model dispatches that exist unchanged on every
+# backend.
+# ---------------------------------------------------------------------------
+
+_ONLINE_LOOP_SCRIPT = r"""
+import json, os, sys, tempfile, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from deeplearning4j_tpu.datasets.iterator import DataSet
+from deeplearning4j_tpu.etl.normalize import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf import (DenseLayer, NeuralNetConfiguration,
+                                        OutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.online import (ContinuousTrainer, DriftMonitor,
+                                       ShadowPromoter, StreamSource)
+from deeplearning4j_tpu.resilience import CheckpointManager
+from deeplearning4j_tpu.serving.engine import ServingEngine
+
+batches, predicts = int(sys.argv[1]), int(sys.argv[2])
+F, B, C = 16, 32, 3
+rng = np.random.default_rng(0)
+X = rng.standard_normal((batches * B, F)).astype(np.float32)
+Y = np.eye(C, dtype=np.float32)[rng.integers(0, C, batches * B)]
+norm = NormalizerStandardize().fit(X)
+
+
+def net(seed):
+    conf = (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+            .updater("adam").list()
+            .layer(0, DenseLayer(n_in=F, n_out=32, activation="tanh"))
+            .layer(1, OutputLayer(n_in=32, n_out=C, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf)
+
+
+tmp = tempfile.mkdtemp(prefix="bench_online_")
+cand_zip = os.path.join(tmp, "candidate.zip")
+
+# -- phase 1: ingest + fit round + candidate export ------------------------
+mgr = CheckpointManager(os.path.join(tmp, "ckpt"), every_steps=0,
+                        keep_last=2)
+src = StreamSource(watermark=batches + 1, idle_s=0.05)
+drift = DriftMonitor(norm, min_rows=B)
+ct = ContinuousTrainer(net(7), src, manager=mgr, drift=drift,
+                       normalizer=norm, workers=1, shard=None,
+                       candidate_path=cand_zip, snapshot_rounds=1,
+                       handle_signals=False)
+ct.fit_round()  # warm round: jit compiles + checkpoint machinery
+for i in range(batches):
+    src.push(DataSet(X[i * B:(i + 1) * B], Y[i * B:(i + 1) * B]))
+t0 = time.perf_counter()
+losses = ct.fit_round()
+fit_s = time.perf_counter() - t0
+assert len(losses) == batches and os.path.exists(cand_zip)
+drift_verdict = drift.check()["verdict"]
+src.close()
+mgr.close()
+
+# -- phase 2/3: serve a prior default, stage the candidate, measure the
+# shadow-mirror cost on the answered /predict path ------------------------
+eng = ServingEngine(model=net(3).init(), input_shape=(F,), max_batch=16)
+rows = X[:8]
+for _ in range(4):
+    eng.predict(rows)  # warm the primary's ladder
+
+promoter = ShadowPromoter(eng, drift=drift, min_mirrored=1, fraction=1.0)
+t0 = time.perf_counter()
+rec = promoter.stage("candidate", model_path=cand_zip, input_shape=(F,),
+                     max_batch=16)
+stage_s = time.perf_counter() - t0
+mirror = promoter.mirror
+eng.predict(rows); mirror.wait_idle()  # warm the candidate dispatch too
+
+
+def median_predict_s(mirror_on):
+    # interleave-friendly single pass; the mirror worker is drained
+    # OUTSIDE the timer after every predict (1-core host: leaving the
+    # shadow dispatch in flight would time core contention, not the
+    # offer-path stride the client actually pays)
+    ts = []
+    for _ in range(predicts):
+        t0 = time.perf_counter()
+        eng.predict(rows)
+        ts.append(time.perf_counter() - t0)
+        if mirror_on:
+            mirror.wait_idle()
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+pairs = []
+for _ in range(3):
+    eng.detach_shadow(mirror)
+    off = median_predict_s(False)
+    eng.attach_shadow(mirror)
+    on = median_predict_s(True)
+    pairs.append((off, on))
+ratios = sorted(on / off for off, on in pairs)
+ratio = ratios[len(ratios) // 2]
+mirror.wait_idle()
+
+# -- phase 4: gated promotion (atomic default swap) ------------------------
+t0 = time.perf_counter()
+report = promoter.promote()
+promote_s = time.perf_counter() - t0
+assert eng.registry.default().key == rec.key
+snap = promoter.online_stats.snapshot()
+eng.stop(drain=False)
+
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "stream_batches": batches, "batch_rows": B, "features": F,
+    "ingest_fit_round_s": round(fit_s, 4),
+    "batches_per_sec": round(batches / fit_s, 2),
+    "stage_s": round(stage_s, 4),
+    "promote_s": round(promote_s, 4),
+    "cycle_s": round(fit_s + stage_s + promote_s, 4),
+    "drift_verdict": drift_verdict,
+    "mirrored": report["mirrored"],
+    "agreement": report["agreement"],
+    "prior_default": report["prior_default"],
+    "shadow_overhead_pct": round((ratio - 1.0) * 100.0, 2),
+    "shadow_overhead_reps_pct": [round((r - 1.0) * 100.0, 2)
+                                 for r in ratios],
+    "overhead_bar_pct": 3.0,
+    "overhead_ok": bool(ratio - 1.0 < 0.03),
+    "promotions": snap["promotions"],
+    "stat": "single timed pass per phase after a warm round; shadow "
+            "overhead = median of 3 interleaved mirror-off/on "
+            "median-predict ratios, mirror drained outside the timer",
+    "note": "1-core host: phase times are host-side orchestration around "
+            "tiny CPU dispatches; the offer-path overhead fraction "
+            "upper-bounds the on-chip one (chip dispatches are ~5ms)",
+}))
+"""
+
+
+def bench_online_loop(batches=12, predicts=24):
+    """Online learning loop leg (online/): end-to-end cycle time of
+    streaming ingest -> fit round -> candidate export -> shadow stage ->
+    gated promotion, and the shadow-mirror overhead on the answered
+    /predict path (bar < 3% — the mirror must be invisible to clients in
+    time as well as bytes). Subprocess-isolated, CPU-only by design —
+    the loop is host-side orchestration on every backend."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _ONLINE_LOOP_SCRIPT, str(batches),
+         str(predicts)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
 # obs_overhead: per-step cost of the observability plane (ISSUE 7 —
 # deeplearning4j_tpu/obs/). CPU-measurable by design: spans/journal/
 # registry are HOST-side events only (never a device sync), so the
@@ -2829,7 +2992,8 @@ _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "checkpoint_overhead",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
-                  "obs_overhead", "paged_kernel", "sgns_kernel"}
+                  "obs_overhead", "paged_kernel", "sgns_kernel",
+                  "online_loop"}
 
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_PARTIAL.json")
@@ -3100,6 +3264,8 @@ def main():
     run("input_pipeline", bench_input_pipeline,
         batches=8 if quick else 20)
     run("elastic_dp", bench_elastic_dp, rounds=6 if quick else 10)
+    run("online_loop", bench_online_loop,
+        batches=6 if quick else 12, predicts=12 if quick else 24)
     run("obs_overhead", bench_obs_overhead, steps=50 if quick else 150)
     run("reference_cpu_lenet5_torch", bench_torch_lenet_cpu,
         steps=3 if quick else 8)

@@ -441,6 +441,14 @@ class InputPipeline(DataSetIterator):
                 seq_base = int(resume.get("next_seq", 0))
             else:
                 skip_below = int(resume.get("next_seq", 0))
+            # a resumed pass that delivers ZERO batches (an idle live
+            # stream — the poll window closed empty) must keep answering
+            # the restored position from state(), not fall back to a
+            # next_seq-0 snapshot; keep only the cursor keys — the shard
+            # schedule/pending reshard are re-read LIVE by state()
+            self._last_state = {k: resume[k]
+                                for k in ("mode", "next_seq", "source")
+                                if k in resume}
         stats = self.pipeline_stats
         stats.start_pass()
         coord = _Coordination(self.prefetch + self.workers)

@@ -1,0 +1,41 @@
+"""Online learning loop: streaming ingest → continuous fit → drift
+alarms → shadow eval → hot promotion.
+
+The reference's streaming story (deeplearning4j-scaleout streaming —
+Camel/Kafka ingest routes feeding the Spark training master, one model
+per serving route) rebuilt on this repo's planes: ``StreamSource`` is the
+broker-consumer contract as an ``InputPipeline`` source (monotone
+offsets, backpressure, the delivered-batch cursor IS the committed
+offset), ``ContinuousTrainer`` drives round-based incremental fit under
+the ``ResilientTrainer`` fault plane, ``DriftMonitor`` compares live
+feature moments against the training-time fitted normalizer, and
+``ShadowPromoter`` promotes a candidate through the serving registry
+behind live gates (shadow traffic mirroring, drift veto, atomic swap
+with recorded rollback lineage).
+"""
+
+from deeplearning4j_tpu.online.drift import DriftMonitor
+from deeplearning4j_tpu.online.promote import (
+    PromotionRefused,
+    ShadowMirror,
+    ShadowPromoter,
+)
+from deeplearning4j_tpu.online.stats import OnlineStats
+from deeplearning4j_tpu.online.stream import (
+    StreamBackpressure,
+    StreamClosed,
+    StreamSource,
+)
+from deeplearning4j_tpu.online.trainer import ContinuousTrainer
+
+__all__ = [
+    "ContinuousTrainer",
+    "DriftMonitor",
+    "OnlineStats",
+    "PromotionRefused",
+    "ShadowMirror",
+    "ShadowPromoter",
+    "StreamBackpressure",
+    "StreamClosed",
+    "StreamSource",
+]

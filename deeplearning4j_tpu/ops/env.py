@@ -213,6 +213,32 @@ _register("DL4J_TPU_FLEET_MIN_WORKERS", "1", "int",
 _register("DL4J_TPU_FLEET_DIR", "", "path",
           "default fleet spool/file-membership transport dir")
 
+# online learning (online/)
+_register("DL4J_TPU_ONLINE_WATERMARK", "64", "int",
+          "StreamSource backpressure high watermark: push() blocks while "
+          "this many batches sit undelivered")
+_register("DL4J_TPU_ONLINE_IDLE_S", "0.2", "float",
+          "idle window (seconds with no arrival) that ends a StreamSource "
+          "poll pass / ContinuousTrainer fit round (0 = block until close)")
+_register("DL4J_TPU_ONLINE_SNAPSHOT_ROUNDS", "1", "int",
+          "candidate-snapshot cadence in fit rounds for "
+          "ContinuousTrainer.export_candidate paths (0 = off)")
+_register("DL4J_TPU_ONLINE_DRIFT_Z", "3.0", "float",
+          "DriftMonitor alarm threshold: max per-column "
+          "|live_mean - base_mean| / base_std")
+_register("DL4J_TPU_ONLINE_DRIFT_MIN", "64", "int",
+          "minimum live rows before DriftMonitor.check() renders a "
+          "verdict")
+_register("DL4J_TPU_ONLINE_SHADOW_FRACTION", "1.0", "float",
+          "fraction of answered /predict traffic mirrored to the shadow "
+          "candidate (deterministic stride, not RNG)")
+_register("DL4J_TPU_ONLINE_SHADOW_MIN", "32", "int",
+          "minimum mirrored requests before ShadowPromoter.evaluate() "
+          "will pass a candidate")
+_register("DL4J_TPU_ONLINE_GATE_AGREE", "0.0", "float",
+          "promotion gate: minimum shadow-vs-primary argmax agreement "
+          "fraction (0 disables the agreement gate)")
+
 # bench / examples harness (bench.py, examples/)
 _register("DL4J_TPU_EXAMPLE_SMOKE", "", "flag",
           "any non-empty value shrinks every examples/*.py to smoke-tier "

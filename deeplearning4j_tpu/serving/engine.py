@@ -182,6 +182,11 @@ class ServingEngine:
         self._no_decoder: set = set()  # records probed and found ineligible
         self._lock = threading.Lock()       # naive path + generate serialization
         self._engine_lock = threading.Lock()  # batcher/decoder creation
+        # shadow mirror (ISSUE 14 — online/promote.ShadowMirror): when
+        # attached, a fraction of answered /predict traffic is offered to
+        # the candidate model OFF-thread; offer() never raises, never
+        # blocks, never votes a breaker — the client path is unchanged
+        self._shadow = None
         if model is not None or model_path is not None:
             # normalizer: explicit wins; a checkpoint zip's own section
             # otherwise (registry.load reads it) — /predict then applies
@@ -260,12 +265,31 @@ class ServingEngine:
                     breaker.record_failure(f"{type(e).__name__}: {e}")
                     raise
                 breaker.record_success()
+                self._offer_shadow(x, out)
                 return out
             batcher = self._batcher_for(rec)
             # rid threads THROUGH the batcher: the serve.batch span on
             # the worker thread lists it, joining this request's span to
             # the coalesced dispatch it rode in
-            return batcher.predict(x, timeout_s=timeout_s, rid=rid)
+            out = batcher.predict(x, timeout_s=timeout_s, rid=rid)
+            self._offer_shadow(x, out)
+            return out
+
+    def attach_shadow(self, mirror) -> None:
+        """Install a shadow mirror on the /predict answer path. One at a
+        time — promotion is a serialized operator action."""
+        self._shadow = mirror
+
+    def detach_shadow(self, mirror=None) -> None:
+        """Remove the mirror (idempotent; a specific ``mirror`` detaches
+        only itself, so a stale promoter can't evict its successor)."""
+        if mirror is None or self._shadow is mirror:
+            self._shadow = None
+
+    def _offer_shadow(self, x, out) -> None:
+        shadow = self._shadow
+        if shadow is not None:
+            shadow.offer(x, out)
 
     def generate(self, tokens: np.ndarray, n_new: int, *,
                  temperature: float = 1.0, seed: int = 0,
@@ -642,6 +666,9 @@ class ServingEngine:
                         # 11 satellite): what the /generate plane can
                         # actually hold, not what it pre-allocated
                         "kv": engine.kv_report(),
+                        # serve()-swap history (ISSUE 14 satellite): the
+                        # audited rollback trail — who replaced whom, when
+                        "lineage": engine.registry.lineage(),
                     })
                 else:
                     self._send(404, {"error": "not found"})

@@ -72,6 +72,9 @@ class ModelRecord:
         self.error: Optional[str] = None  # set when state == "broken"
         self.loaded_ts = time.strftime("%Y-%m-%dT%H:%M:%S")
         self.warmed_buckets: List[int] = []
+        # the default this record REPLACED when serve() promoted it
+        # ("name@vN" or None) — the auditable rollback target (ISSUE 14)
+        self.prior_default: Optional[str] = None
 
     @property
     def key(self) -> str:
@@ -93,6 +96,8 @@ class ModelRecord:
             out["input_shape"] = list(self.input_shape)
         if self.normalizer is not None:
             out["normalizer"] = type(self.normalizer).__name__
+        if self.prior_default is not None:
+            out["prior_default"] = self.prior_default
         stats = getattr(self.model, "dispatch_stats", None)
         if stats is not None:
             out["dispatch_stats"] = stats.snapshot()
@@ -110,6 +115,10 @@ class ModelRegistry:
         self.chaos = chaos
         self.stats = stats
         self._sealed = False
+        # version lineage (ISSUE 14 satellite): every serve() swap is
+        # recorded {"ts", "from", "to"} so a post-promotion rollback
+        # target is auditable at /models, not just implicit
+        self._lineage: List[Dict[str, Any]] = []
 
     def seal(self) -> None:
         """Freeze the lifecycle for shutdown (ISSUE 12 satellite): the
@@ -277,7 +286,53 @@ class ModelRegistry:
                 old = self._records.get(prev[0], {}).get(prev[1])
                 if old is not None and old.state == "serving":
                     old.state = "warm"
+            if prev != self._default:
+                prev_key = f"{prev[0]}@v{prev[1]}" if prev else None
+                rec.prior_default = prev_key
+                self._lineage.append({
+                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    "from": prev_key, "to": rec.key})
         return rec
+
+    def mark_broken(self, name: str, version: Optional[int] = None, *,
+                    error: str = "promotion gate failed") -> ModelRecord:
+        """Land a record BROKEN post-hoc (the shadow promoter's refusal
+        path: a candidate that warmed clean but failed its promotion
+        gates must not stay promotable). Refuses to break the serving
+        default — traffic never moves onto or off of a record through
+        this door; error preserved for /models like any isolation."""
+        rec = self.get(name, version)
+        with self._lock:
+            if self._default == (rec.name, rec.version):
+                raise ValueError(
+                    f"{rec.key} is the serving default; mark_broken would "
+                    "break live traffic — demote it first")
+            rec.state = "broken"
+            rec.error = str(error)
+        return rec
+
+    # -- lineage ----------------------------------------------------------
+    def lineage(self) -> List[Dict[str, Any]]:
+        """The serve()-swap history, oldest first."""
+        with self._lock:
+            return [dict(e) for e in self._lineage]
+
+    def rollback_target(self) -> Optional[Tuple[str, int]]:
+        """(name, version) the CURRENT default replaced, if that record
+        is still promotable (loaded, not broken/unloaded) — the audited
+        answer to "what do we roll back to"."""
+        with self._lock:
+            if self._default is None:
+                return None
+            rec = self._records[self._default[0]][self._default[1]]
+            prior = rec.prior_default
+            if prior is None:
+                return None
+            pname, _, pver = prior.rpartition("@v")
+            old = self._records.get(pname, {}).get(int(pver))
+            if old is None or old.model is None or old.state == "broken":
+                return None
+            return pname, int(pver)
 
     def unload(self, name: str, version: Optional[int] = None) -> ModelRecord:
         """Drop the record's model and free its device buffers NOW."""

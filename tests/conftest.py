@@ -104,6 +104,11 @@ _QUICK_FILES = {
     # forced-transcript, and gate contracts) — tiny shapes, ~30s
     "test_pallas_paged.py",
     "test_pallas_sgns.py",
+    # online learning loop (ISSUE 14): kill/resume through a live
+    # StreamSource bit-exactness, zero-failed-request promotion swap,
+    # deterministic drift veto, mirror byte-invisibility — tiny nets,
+    # ~15s
+    "test_online.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and

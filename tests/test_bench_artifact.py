@@ -206,6 +206,20 @@ def test_elastic_dp_leg_registered():
     assert "elastic_dp" in m._CPU_ONLY_LEGS
 
 
+def test_online_loop_leg_registered():
+    """ISSUE 14: the online_loop leg (ingest -> fit round -> candidate
+    export -> shadow stage -> gated promotion cycle time + the
+    shadow-mirror /predict overhead bar) is in the expected set AND in
+    bench.py's CPU-only set — the loop is host-side orchestration, so
+    its proof must run (and persist) even with the tunnel dead."""
+    from scripts.bench_state import EXPECTED, expected_legs
+
+    assert "online_loop" in EXPECTED
+    assert "online_loop" in expected_legs()
+    m = _load_bench()
+    assert "online_loop" in m._CPU_ONLY_LEGS
+
+
 def test_kernel_legs_registered():
     """ISSUE 13: the paged_kernel / sgns_kernel legs (interpret-mode CPU
     equivalence when the tunnel is dead, compiled real-chip measured-win

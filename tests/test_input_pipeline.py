@@ -398,6 +398,58 @@ class TestResume:
         rest = list(fresh)
         assert [ds_bytes(d) for d in rest] == [ds_bytes(d) for d in ref[3:]]
 
+    def test_cursor_survives_empty_poll_window(self):
+        """ISSUE 14 satellite: a restored cursor must survive a pass that
+        delivers ZERO batches (an exhausted live stream idling between
+        poll windows) — state() keeps answering the restored position
+        instead of resetting to a next_seq-0 snapshot, so the refilled
+        window resumes at the right offset with no double-skip."""
+
+        class Refillable:
+            """Exhausted-then-refilled source: each __iter__ is one poll
+            window draining whatever arrived since the cursor."""
+
+            def __init__(self):
+                self.data = list(ListDataSetIterator(X[:64], Y[:64], 16))
+                self.pos = 0
+
+            def __iter__(self):
+                while self.pos < len(self.data):
+                    ds = self.data[self.pos]
+                    self.pos += 1
+                    yield ds
+
+            def state(self):
+                return {"pos": self.pos}
+
+            def restore_state(self, st):
+                self.pos = int(st["pos"])
+
+        src = Refillable()
+        pipe = InputPipeline(src, workers=2, device_put=False)
+        first = list(pipe)  # window 1 drains the 4 available batches
+        assert len(first) == 4
+        st = pipe.state()
+        assert st["mode"] == "source" and st["next_seq"] == 4
+
+        # fresh process: restore, then the stream idles — an EMPTY window
+        fresh_src = Refillable()
+        fresh = InputPipeline(fresh_src, workers=2, device_put=False)
+        fresh.restore_state(st)
+        assert list(fresh) == []
+        st2 = fresh.state()
+        assert st2["mode"] == "source" and st2["next_seq"] == 4
+        assert st2["source"] == {"pos": 4}
+
+        # the stream refills: re-anchor on the preserved cursor and the
+        # new batches arrive at the right absolute offsets, exactly once
+        more = list(ListDataSetIterator(X[64:], Y[64:], 16))
+        fresh_src.data.extend(more)
+        fresh.restore_state(st2)
+        got = list(fresh)
+        assert [ds_bytes(d) for d in got] == [ds_bytes(d) for d in more]
+        assert fresh.state()["next_seq"] == 4 + len(more)
+
     def test_state_before_any_delivery(self):
         pipe = InputPipeline(ListDataSetIterator(X, Y, 16), workers=1,
                              device_put=False)

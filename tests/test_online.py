@@ -1,0 +1,544 @@
+"""Online learning loop (deeplearning4j_tpu/online/) — ISSUE 14.
+
+Quick-tier contracts:
+
+  (a) training KILLED at stream offset k and RESUMED through a live
+      StreamSource produces bit-identical params and loss curve to the
+      uninterrupted run — the delivered-batch cursor IS the stream
+      offset (Kafka committed-offset replay).
+  (b) a COMPLETED promotion serves the candidate with zero
+      dropped/failed admitted requests during the swap; an INJECTED
+      warmup failure leaves the prior default serving with the
+      candidate broken (PR 8 isolation, never moving the default).
+  (c) a scripted distribution shift fires the drift alarm
+      deterministically and BLOCKS promotion.
+  (d) shadow mirroring on => client-visible /predict outputs
+      byte-identical to mirroring off.
+
+Plus the ISSUE 14 satellites: registry version lineage
+(prior_default/lineage/rollback_target + /models exposure) and the
+promotion races (drain mid-shadow seals the lifecycle without promoting;
+a failing shadow model never votes the primary's breaker).
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterator import DataSet
+from deeplearning4j_tpu.etl.normalize import NormalizerStandardize
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.online import (
+    ContinuousTrainer,
+    DriftMonitor,
+    PromotionRefused,
+    ShadowPromoter,
+    StreamBackpressure,
+    StreamClosed,
+    StreamSource,
+)
+from deeplearning4j_tpu.resilience import (
+    ChaosConfig,
+    ChaosMonkey,
+    CheckpointManager,
+    InjectedKill,
+)
+from deeplearning4j_tpu.resilience.chaos import (
+    InjectedServingFault,
+    ServingChaos,
+    ServingChaosConfig,
+)
+from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.resilience import DrainingError
+from deeplearning4j_tpu.utils.serialization import ModelSerializer
+
+_RNG = np.random.default_rng(0)
+X = _RNG.standard_normal((96, 6)).astype(np.float32)
+Y = np.eye(3, dtype=np.float32)[_RNG.integers(0, 3, 96)]
+
+
+def build_net(seed=7) -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+        .updater("adam").list()
+        .layer(0, DenseLayer(n_in=6, n_out=8, activation="tanh"))
+        .layer(1, OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf)
+
+
+def push_all(src: StreamSource, upto: int = 96, batch: int = 8) -> int:
+    n = 0
+    for i in range(0, upto, batch):
+        src.push(DataSet(X[i:i + batch], Y[i:i + batch]))
+        n += 1
+    return n
+
+
+def params_equal(a, b) -> bool:
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def fitted_norm() -> NormalizerStandardize:
+    return NormalizerStandardize().fit(X)
+
+
+# ---------------------------------------------------------------------------
+# StreamSource semantics
+# ---------------------------------------------------------------------------
+
+
+class TestStreamSource:
+    def test_offsets_monotone_and_in_order(self):
+        src = StreamSource(watermark=32, idle_s=0.05)
+        offs = [src.push(DataSet(X[i:i + 8], Y[i:i + 8]))
+                for i in range(0, 32, 8)]
+        assert offs == [0, 1, 2, 3]
+        got = list(src)  # one poll window drains the backlog then idles
+        assert len(got) == 4
+        np.testing.assert_array_equal(np.asarray(got[0].features), X[:8])
+        assert src.state() == {"offset": 4}
+        assert list(src) == []  # idle window: empty pass, cursor keeps
+
+    def test_backpressure_blocks_then_raises(self):
+        src = StreamSource(watermark=2, idle_s=0.05)
+        push_all(src, upto=16)  # fills the 2-batch watermark
+        t0 = time.monotonic()
+        with pytest.raises(StreamBackpressure):
+            src.push(DataSet(X[:8], Y[:8]), timeout_s=0.2)
+        assert time.monotonic() - t0 >= 0.15
+        # delivering frees headroom: the next push admits immediately
+        assert len(list(src)) == 2
+        assert src.push(DataSet(X[:8], Y[:8]), timeout_s=1.0) == 2
+
+    def test_close_drains_then_refuses(self):
+        src = StreamSource(watermark=8, idle_s=10.0)  # long idle: close ends
+        push_all(src, upto=16)
+        src.close()
+        assert len(list(src)) == 2  # buffered batches still deliver
+        with pytest.raises(StreamClosed):
+            src.push(DataSet(X[:8], Y[:8]))
+
+    def test_restore_state_seeks(self):
+        src = StreamSource(watermark=32, idle_s=0.05)
+        push_all(src, upto=32)
+        src.restore_state({"offset": 2})
+        got = list(src)
+        assert len(got) == 2  # offsets 0,1 dropped as already-consumed
+        np.testing.assert_array_equal(np.asarray(got[0].features), X[16:24])
+
+
+# ---------------------------------------------------------------------------
+# Contract (a): kill at stream offset k + resume == uninterrupted
+# ---------------------------------------------------------------------------
+
+
+class TestKillResumeThroughStream:
+    def _run(self, manager, *, chaos=None, prefill=96):
+        src = StreamSource(watermark=64, idle_s=0.1)
+        push_all(src, upto=prefill)
+        ct = ContinuousTrainer(build_net(), src, manager=manager,
+                               workers=1, shard=None, chaos=chaos,
+                               handle_signals=False)
+        ct.fit_round()
+        return ct
+
+    def test_kill_resume_bit_exact(self, tmp_path):
+        baseline = self._run(None)
+        assert baseline.step == 12
+
+        mgr = CheckpointManager(str(tmp_path), every_steps=4, keep_last=3)
+        with pytest.raises(InjectedKill):
+            self._run(mgr, chaos=ChaosMonkey(ChaosConfig(kill_at_step=6)))
+        mgr.close()
+
+        # resume: FRESH process shape — new net, new source, the producer
+        # re-pushes from the committed offset (restore_state drops below)
+        mgr2 = CheckpointManager(str(tmp_path), every_steps=4, keep_last=3)
+        resumed = self._run(mgr2)
+        mgr2.close()
+
+        assert resumed.resilient.resumed_step == 4  # checkpoint at step 4
+        assert resumed.step == baseline.step
+        assert params_equal(baseline.net.params, resumed.net.params)
+        assert params_equal(baseline.net.updater_state,
+                            resumed.net.updater_state)
+        stitched = (baseline.losses[:resumed.resilient.resumed_step]
+                    + resumed.losses)
+        assert stitched == baseline.losses, "loss curve diverged"
+
+    def test_cursor_survives_empty_round(self, tmp_path):
+        """An idle poll window (zero batches) must not move the committed
+        offset or spam checkpoints — the next data round continues."""
+        mgr = CheckpointManager(str(tmp_path), every_steps=4, keep_last=3)
+        src = StreamSource(watermark=64, idle_s=0.05)
+        ct = ContinuousTrainer(build_net(), src, manager=mgr,
+                               workers=1, shard=None, handle_signals=False)
+        push_all(src, upto=32)
+        assert len(ct.fit_round()) == 4
+        assert ct.fit_round() == []          # idle window, empty round
+        assert ct.rounds_done == 1           # not counted
+        push_all(src, upto=32)
+        assert len(ct.fit_round()) == 4
+        assert ct.step == 8
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Contracts (b)+(d) and the promotion races
+# ---------------------------------------------------------------------------
+
+
+def serving_net(seed=7) -> MultiLayerNetwork:
+    net = build_net(seed).init()
+    net.fit(X[:32], Y[:32])
+    return net
+
+
+@pytest.fixture()
+def candidate_zip(tmp_path):
+    path = str(tmp_path / "candidate.zip")
+    ModelSerializer.write_model(serving_net(11), path,
+                                normalizer=fitted_norm())
+    return path
+
+
+class TestShadowPromotion:
+    def test_mirroring_on_is_byte_invisible(self, candidate_zip):
+        """Contract (d): the same rows answer byte-identically with the
+        mirror attached vs not — shadow answers never reach clients."""
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            rows = [X[i:i + 8] for i in range(0, 64, 8)]
+            before = [eng.predict(r) for r in rows]
+            promoter = ShadowPromoter(eng, min_mirrored=1, fraction=1.0)
+            promoter.stage("candidate", model_path=candidate_zip,
+                           input_shape=(6,), max_batch=16)
+            after = [eng.predict(r) for r in rows]
+            for b, a in zip(before, after):
+                np.testing.assert_array_equal(b, a)
+            assert promoter.mirror.wait_idle()
+            assert promoter.mirror.report()["mirrored"] == len(rows)
+            promoter.abort("test teardown")
+        finally:
+            eng.stop(drain=False)
+
+    def test_promotion_swap_zero_failed_requests(self, candidate_zip):
+        """Contract (b): requests hammered across the atomic swap all
+        succeed, and each answer is byte-attributable to exactly the
+        primary or the candidate (never a torn mix)."""
+        primary = serving_net()
+        eng = ServingEngine(model=primary, input_shape=(6,), max_batch=16)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=2, fraction=1.0)
+            rec = promoter.stage("candidate", model_path=candidate_zip,
+                                 input_shape=(6,), max_batch=16)
+            rows = X[:8]
+            for _ in range(4):
+                eng.predict(rows)
+            assert promoter.mirror.wait_idle()
+            want_primary = eng.predict(rows)
+            cand_norm = rec.normalizer
+            want_cand = np.asarray(
+                rec.model.output(cand_norm.transform_array(rows)))
+
+            stop = threading.Event()
+            failures, answers = [], []
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        answers.append(eng.predict(rows))
+                    except Exception as e:  # noqa: BLE001 — the contract
+                        failures.append(e)
+
+            with ThreadPoolExecutor(4) as ex:
+                futs = [ex.submit(hammer) for _ in range(4)]
+                time.sleep(0.05)
+                report = promoter.promote()
+                time.sleep(0.05)
+                stop.set()
+                for f in futs:
+                    f.result(timeout=30)
+
+            assert report["ok"] and report["promoted"] == rec.key
+            assert not failures, f"requests failed across swap: {failures!r}"
+            assert answers
+            for out in answers:
+                assert (np.array_equal(out, want_primary)
+                        or np.array_equal(out, want_cand)), "torn answer"
+            # swap completed: the default now answers with the candidate
+            np.testing.assert_array_equal(eng.predict(rows), want_cand)
+            assert eng.registry.default().key == rec.key
+            assert eng._shadow is None  # mirror detached after promotion
+        finally:
+            eng.stop(drain=False)
+
+    def test_injected_warmup_failure_never_moves_default(self, candidate_zip):
+        """Contract (b), failure half: chaos-injected warmup failure
+        lands the candidate broken; the prior default keeps serving."""
+        chaos = ServingChaos(ServingChaosConfig(warmup_fail_name="candidate"))
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16, chaos=chaos)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1)
+            with pytest.raises(InjectedServingFault):
+                promoter.stage("candidate", model_path=candidate_zip,
+                               input_shape=(6,), max_batch=16)
+            assert eng.registry.default().key == "default@v1"
+            assert eng.registry.get("candidate").state == "broken"
+            assert eng._shadow is None  # nothing attached on failed stage
+            out = eng.predict(X[:8])    # prior default still answers
+            assert out.shape == (8, 3)
+        finally:
+            eng.stop(drain=False)
+
+    def test_gate_failure_refuses_and_breaks_candidate(self, candidate_zip):
+        """A failed promotion gate (insufficient mirrored volume) refuses,
+        marks the candidate broken, and never moves the default."""
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1000)
+            rec = promoter.stage("candidate", model_path=candidate_zip,
+                                 input_shape=(6,), max_batch=16)
+            eng.predict(X[:8])
+            with pytest.raises(PromotionRefused) as ei:
+                promoter.promote()
+            assert any("min_mirrored" in f for f in ei.value.report["failed"])
+            assert eng.registry.default().key == "default@v1"
+            assert eng.registry.get(rec.name, rec.version).state == "broken"
+            assert promoter.online_stats.snapshot()["promotion_refusals"] == 1
+        finally:
+            eng.stop(drain=False)
+
+    def test_shadow_errors_never_vote_primary_breaker(self, candidate_zip):
+        """Satellite 3: a shadow model that CRASHES on every mirrored
+        batch costs the client path nothing — no breaker vote, no failed
+        request — and surfaces as a mirror_errors gate refusal."""
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1)
+            rec = promoter.stage("candidate", model_path=candidate_zip,
+                                 input_shape=(6,), max_batch=16)
+
+            class Exploding:
+                def output(self, x):
+                    raise RuntimeError("shadow boom")
+
+            rec.model = Exploding()  # sabotage AFTER warmup
+            for _ in range(4):
+                out = eng.predict(X[:8])  # client path never notices
+                assert out.shape == (8, 3)
+            assert promoter.mirror.wait_idle()
+            snap = promoter.online_stats.snapshot()
+            assert snap["mirror_errors"] >= 1
+            assert eng.stats.snapshot()["breaker_opens"] == 0
+            assert eng._breakers["default@v1"].state == "serving"
+            with pytest.raises(PromotionRefused) as ei:
+                promoter.promote()
+            assert any("mirror_errors" in f
+                       for f in ei.value.report["failed"])
+            assert eng.registry.default().key == "default@v1"
+        finally:
+            eng.stop(drain=False)
+
+    def test_drain_mid_shadow_seals_without_promoting(self, candidate_zip):
+        """Satellite 3: a drain racing the promotion hits the SEALED
+        registry — DrainingError, default unmoved, candidate NOT broken
+        (a drain is not a verdict), mirror detached."""
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1, fraction=1.0)
+            rec = promoter.stage("candidate", model_path=candidate_zip,
+                                 input_shape=(6,), max_batch=16)
+            eng.predict(X[:8])
+            assert promoter.mirror.wait_idle()
+            assert eng.drain(timeout_s=10.0)
+            with pytest.raises(DrainingError):
+                promoter.promote()
+            assert eng.registry.default().key == "default@v1"
+            assert eng.registry.get(rec.name, rec.version).state == "warm"
+            assert eng._shadow is None
+            # and a stage() after the drain began is refused outright
+            with pytest.raises(DrainingError):
+                promoter.stage("candidate2", model_path=candidate_zip,
+                               input_shape=(6,), max_batch=16)
+        finally:
+            eng.stop(drain=False)
+
+    def test_fraction_stride_deterministic(self, candidate_zip):
+        """A 0.5 mirror fraction selects exactly every other answered
+        request — accumulated stride, no RNG."""
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1, fraction=0.5)
+            promoter.stage("candidate", model_path=candidate_zip,
+                           input_shape=(6,), max_batch=16)
+            for _ in range(8):
+                eng.predict(X[:8])
+            assert promoter.mirror.wait_idle()
+            rep = promoter.mirror.report()
+            assert rep["mirrored"] == 4 and rep["skipped"] == 4
+            promoter.abort("test teardown")
+        finally:
+            eng.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Contract (c): deterministic drift alarm blocks promotion
+# ---------------------------------------------------------------------------
+
+
+class TestDrift:
+    def test_in_distribution_stays_quiet(self):
+        mon = DriftMonitor(fitted_norm(), min_rows=32)
+        for i in range(0, 96, 8):
+            mon.observe(X[i:i + 8])
+        v = mon.check()
+        assert v["verdict"] == "ok" and not mon.alarmed
+        assert v["max_z"] < 1.0  # the live window IS the fitted window
+
+    def test_scripted_shift_alarms_deterministically(self):
+        shifted = X + np.asarray([5, 0, 0, 0, 0, 0], np.float32)
+        verdicts = []
+        for _ in range(3):  # identical every run — pure arithmetic
+            mon = DriftMonitor(fitted_norm(), min_rows=32, z_threshold=3.0)
+            for i in range(0, 96, 8):
+                mon.observe(shifted[i:i + 8])
+            verdicts.append(mon.check())
+        assert all(v["verdict"] == "alarm" for v in verdicts)
+        assert len({round(v["max_z"], 9) for v in verdicts}) == 1
+        assert verdicts[0]["column"] == 0  # the shifted column is named
+        # pending below the minimum window: no verdict from thin evidence
+        thin = DriftMonitor(fitted_norm(), min_rows=64)
+        thin.observe(shifted[:8])
+        assert thin.check()["verdict"] == "pending"
+
+    def test_alarm_blocks_promotion(self, candidate_zip):
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            mon = DriftMonitor(fitted_norm(), min_rows=16, z_threshold=3.0)
+            mon.observe(X[:32] + 50.0)  # scripted shift
+            assert mon.check()["verdict"] == "alarm"
+            promoter = ShadowPromoter(eng, drift=mon, min_mirrored=1)
+            rec = promoter.stage("candidate", model_path=candidate_zip,
+                                 input_shape=(6,), max_batch=16)
+            eng.predict(X[:8])
+            assert promoter.mirror.wait_idle()
+            with pytest.raises(PromotionRefused) as ei:
+                promoter.promote()
+            assert "drift_alarm" in ei.value.report["failed"]
+            assert eng.registry.default().key == "default@v1"
+            assert eng.registry.get(rec.name, rec.version).state == "broken"
+        finally:
+            eng.stop(drain=False)
+
+    def test_trainer_feeds_drift_window(self):
+        """ContinuousTrainer offers every delivered batch to the monitor
+        BEFORE the fit step — the drift window sees the training data."""
+        mon = DriftMonitor(fitted_norm(), min_rows=16)
+        src = StreamSource(watermark=64, idle_s=0.05)
+        ct = ContinuousTrainer(build_net(), src, drift=mon,
+                               workers=1, shard=None, handle_signals=False)
+        push_all(src, upto=32)
+        ct.fit_round()
+        v = mon.check()
+        assert v["rows"] == 32 and v["verdict"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: version lineage
+# ---------------------------------------------------------------------------
+
+
+class TestLineage:
+    def test_lineage_and_rollback_target(self, candidate_zip):
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16)
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1, fraction=1.0)
+            promoter.stage("candidate", model_path=candidate_zip,
+                           input_shape=(6,), max_batch=16)
+            eng.predict(X[:8])
+            assert promoter.mirror.wait_idle()
+            promoter.promote()
+            reg = eng.registry
+            assert reg.default().prior_default == "default@v1"
+            lineage = reg.lineage()
+            assert lineage[-1]["from"] == "default@v1"
+            assert lineage[-1]["to"] == "candidate@v1"
+            assert reg.rollback_target() == ("default", 1)
+            # describe() carries the lineage pointer per record
+            cand = [d for d in reg.describe() if d["name"] == "candidate"][0]
+            assert cand["prior_default"] == "default@v1"
+            # rollback re-serves the recorded prior and extends the chain
+            promoter.rollback()
+            assert reg.default().key == "default@v1"
+            assert reg.lineage()[-1]["to"] == "default@v1"
+        finally:
+            eng.stop(drain=False)
+
+    def test_models_endpoint_exposes_lineage(self, candidate_zip):
+        import json
+        import urllib.request
+
+        eng = ServingEngine(model=serving_net(), input_shape=(6,),
+                            max_batch=16).start()
+        try:
+            promoter = ShadowPromoter(eng, min_mirrored=1, fraction=1.0)
+            promoter.stage("candidate", model_path=candidate_zip,
+                           input_shape=(6,), max_batch=16)
+            eng.predict(X[:8])
+            assert promoter.mirror.wait_idle()
+            promoter.promote()
+            with urllib.request.urlopen(eng.url + "/models",
+                                        timeout=30) as r:
+                body = json.loads(r.read())
+            assert body["default"] == "candidate@v1"
+            assert body["lineage"][-1]["from"] == "default@v1"
+            assert body["lineage"][-1]["to"] == "candidate@v1"
+        finally:
+            eng.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Ledger plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestOnlineStatsLedger:
+    def test_trainer_ledger_registered_on_net(self):
+        from deeplearning4j_tpu.obs.registry import default_registry
+
+        src = StreamSource(watermark=8, idle_s=0.05)
+        ct = ContinuousTrainer(build_net(), src, workers=1, shard=None,
+                               handle_signals=False)
+        assert ct.net.online_stats is ct.online_stats
+        ledgers = default_registry().ledgers(ct.net)
+        assert "online_stats" in ledgers
+        push_all(src, upto=16)
+        ct.fit_round()
+        snap = ct.snapshot()
+        assert snap["rounds"] == 1
+        assert snap["delivered_batches"] == 2
+        assert snap["pushed_batches"] == 2
