@@ -2315,6 +2315,175 @@ def bench_online_loop(batches=12, predicts=24):
 
 
 # ---------------------------------------------------------------------------
+# lowprec: the low-precision plane (ISSUE 15 — ops/lowprec.py +
+# etl/calibrate.py). CPU-only leg: every row is MEASURED on the XLA:CPU
+# program with an honest backend label; the chip rows (real HBM halving,
+# int8 MXU throughput) are ARMED for the next tunnel contact, never faked.
+# ---------------------------------------------------------------------------
+
+_LOWPREC_SCRIPT = r"""
+import json, os, sys, time
+steps, reps = int(sys.argv[1]), int(sys.argv[2])
+os.environ.pop("DL4J_TPU_BF16", None)
+os.environ.pop("DL4J_TPU_QUANT", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+import deeplearning4j_tpu.models.transformer as tfm
+from deeplearning4j_tpu.ops import memory as mem
+
+# ---- bf16 train step: measured CPU AOT rows + the dtype-aware analytic
+# accounting. XLA:CPU float-normalizes bf16 compute back to f32 (the
+# flash-leg class of CPU-vs-chip program differences), so the MEASURED
+# CPU temp bytes do NOT shrink — reported honestly; the byte claim the
+# chip cashes is the analytic activations estimate (ib 2 vs 4), which is
+# what transformer_preflight budgets HBM with.
+d, L, heads, seq, batch, vocab = 256, 4, 4, 128, 8, 4096
+cfg = tfm.TransformerConfig(
+    vocab_size=vocab, d_model=d, n_layers=L, n_heads=heads, d_ff=4 * d,
+    max_len=seq, learning_rate=1e-4)
+rng = np.random.default_rng(0)
+toks = rng.integers(0, vocab, (batch, seq + 1))
+x = jnp.asarray(toks[:, :-1], jnp.int32)
+y = jnp.asarray(toks[:, 1:], jnp.int32)
+
+train = {}
+for tmode in ("f32", "bf16"):
+    if tmode == "bf16":
+        os.environ["DL4J_TPU_BF16"] = "1"
+    step = tfm.make_train_step(cfg)
+    # fresh lambdas: jax.eval_shape caches on (fun identity, avals), and
+    # init_opt_state's tree CHANGES with the env knob
+    p_sh = jax.eval_shape(lambda: tfm.init_params(cfg))
+    o_sh = jax.eval_shape(lambda p: tfm.init_opt_state(p), p_sh)
+    compiled = step.lower(p_sh, o_sh, x, y).compile()
+    a = mem.analyze_compiled(compiled)
+    _, pre = mem.transformer_preflight(cfg, batch, hbm_gb=16.0,
+                                       measure_aot=False)
+    params = tfm.init_params(cfg)
+    opt = tfm.init_opt_state(params)
+    params, opt, loss = compiled(params, opt, x, y)  # warm
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = compiled(params, opt, x, y)
+    final = float(loss)  # data-dependent host readback = the fence
+    train[tmode] = {
+        "measured_temp_bytes": None if a is None else a["temp_bytes"],
+        "measured_peak_bytes": None if a is None else a["peak_bytes"],
+        "analytic_act_gb": pre["activations_gb_est"],
+        "train_dtype": pre["train_dtype"],
+        "step_ms": round((time.perf_counter() - t0) / steps * 1000, 1),
+        "loss": round(final, 4),
+    }
+
+def ratio(num, den):
+    return None if not num or not den else round(num / den, 2)
+
+# ---- calibrated int8 serving: a dense stack big enough that the matmul
+# dominates; value delta measured on the SAME batch the rps rows time
+from deeplearning4j_tpu.etl.calibrate import QuantCalibrator
+from deeplearning4j_tpu.nn.conf import (DenseLayer, NeuralNetConfiguration,
+                                        OutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ops import lowprec
+
+F, H, C, b8 = 256, 512, 10, 256
+srng = np.random.default_rng(1)
+SX = srng.standard_normal((512, F)).astype(np.float32)
+SY = np.eye(C, dtype=np.float32)[srng.integers(0, C, 512)]
+conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.01)
+        .updater("adam").list()
+        .layer(0, DenseLayer(n_in=F, n_out=H, activation="relu"))
+        .layer(1, DenseLayer(n_in=H, n_out=H, activation="relu"))
+        .layer(2, OutputLayer(n_in=H, n_out=C, activation="softmax",
+                              loss_function="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+for i in range(0, 512, 128):
+    net.fit(SX[i:i + 128], SY[i:i + 128])
+spec = QuantCalibrator().fit(net, SX[:256]).spec(net)
+qnet = lowprec.QuantizedNet(net, spec)
+xb = SX[:b8]
+delta = float(np.max(np.abs(np.asarray(net.output(xb))
+                            - np.asarray(qnet.output(xb)))))
+serving = {"delta": round(delta, 6),
+           "gate_bar": lowprec.quant_max_delta(),
+           "quantized_layers": qnet.quantized_layers()}
+for pname, m in (("f32", net), ("int8", qnet)):
+    np.asarray(m.output(xb))  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(m.output(xb))
+    dt = time.perf_counter() - t0
+    serving[pname + "_rps"] = round(reps * b8 / dt, 1)
+serving["int8_speedup"] = ratio(serving["int8_rps"], serving["f32_rps"])
+
+# ---- bf16 KV arena: pure accounting (AOT by construction, tunnel-free)
+kcfg = tfm.TransformerConfig(vocab_size=vocab, d_model=512, n_layers=8,
+                             n_heads=8, d_ff=2048, max_len=1024)
+kv = {
+    "block_bytes_f32": mem.kv_block_bytes(kcfg, 16, dtype=jnp.float32),
+    "block_bytes_bf16": mem.kv_block_bytes(kcfg, 16, dtype=jnp.bfloat16),
+    "blocks_f32": mem.kv_arena_blocks(kcfg, 16, hbm_gb=2.0,
+                                      dtype=jnp.float32),
+    "blocks_bf16": mem.kv_arena_blocks(kcfg, 16, hbm_gb=2.0,
+                                       dtype=jnp.bfloat16),
+}
+kv["tokens_ratio"] = ratio(kv["blocks_bf16"], kv["blocks_f32"])
+
+out = {
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "train_config": f"d{d} L{L} h{heads} b{batch} s{seq} v{vocab}",
+    "timed_steps": steps,
+    "train": train,
+    # the accounting-plane headline: activation bytes halve under bf16
+    "analytic_act_reduction_x": ratio(train["f32"]["analytic_act_gb"],
+                                      train["bf16"]["analytic_act_gb"]),
+    # the honest CPU fact: XLA:CPU float-normalization keeps f32 buffers
+    "measured_cpu_temp_ratio_x": ratio(
+        train["f32"]["measured_temp_bytes"],
+        train["bf16"]["measured_temp_bytes"]),
+    "bf16_step_overhead_cpu": ratio(train["bf16"]["step_ms"],
+                                    train["f32"]["step_ms"]),
+    "serving_int8": serving,
+    "kv_arena": kv,
+    "note": ("CPU rows measure the XLA:CPU program (bf16 is "
+             "float-normalized to f32 and int8 dot_general has no MXU): "
+             "the byte/throughput wins are chip claims — the HBM AOT row "
+             "and the int8 rps row land at the next tunnel contact; the "
+             "delta/equivalence rows are backend-independent facts"),
+    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+}
+tmp = "LOWPREC_BENCH.json.tmp"
+with open(tmp, "w") as f:
+    json.dump(out, f, indent=1, sort_keys=True)
+os.replace(tmp, "LOWPREC_BENCH.json")
+print(json.dumps(out))
+"""
+
+
+def bench_lowprec(steps=2, reps=20):
+    """Low-precision plane leg (ISSUE 15): (a) the f32-vs-bf16 train
+    step — measured CPU AOT bytes (honest: XLA:CPU float-normalizes
+    bf16, no byte win on this substrate) beside the dtype-aware analytic
+    accounting whose activation estimate halves (the claim the chip
+    budgetes HBM with); (b) calibrated int8 serving rps vs f32 with the
+    MEASURED accuracy delta against the gate bar; (c) the bf16 KV-arena
+    sizing (2x tokens per budget). Subprocess-isolated, CPU-only by
+    design; writes LOWPREC_BENCH.json beside the bench artifact."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _LOWPREC_SCRIPT, str(steps), str(reps)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
 # obs_overhead: per-step cost of the observability plane (ISSUE 7 —
 # deeplearning4j_tpu/obs/). CPU-measurable by design: spans/journal/
 # registry are HOST-side events only (never a device sync), so the
@@ -2993,7 +3162,7 @@ _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
                   "obs_overhead", "paged_kernel", "sgns_kernel",
-                  "online_loop"}
+                  "online_loop", "lowprec"}
 
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_PARTIAL.json")
@@ -3266,6 +3435,8 @@ def main():
     run("elastic_dp", bench_elastic_dp, rounds=6 if quick else 10)
     run("online_loop", bench_online_loop,
         batches=6 if quick else 12, predicts=12 if quick else 24)
+    run("lowprec", bench_lowprec, steps=1 if quick else 2,
+        reps=8 if quick else 20)
     run("obs_overhead", bench_obs_overhead, steps=50 if quick else 150)
     run("reference_cpu_lenet5_torch", bench_torch_lenet_cpu,
         steps=3 if quick else 8)

@@ -213,8 +213,34 @@ def mask_tokens(tokens: np.ndarray, cfg: BertConfig,
 
 def _build_mlm_step(cfg: BertConfig):
     _validate_schedule(cfg)  # same loud rejection as the flagship's step
+    from deeplearning4j_tpu.ops import lowprec
+
+    lp = lowprec.train_policy()
 
     def step(params, opt, inputs, targets, weights):
+        if lp:
+            # bf16 master-weight mode (ops/lowprec.py, same shape as
+            # transformer._build_step): scale rides the opt tree, the
+            # backward runs on the scaled loss of the bf16-cast params
+            ls = lowprec.opt_scale_state(opt)
+            base = {"m": opt["m"], "v": opt["v"], "t": opt["t"]}
+            scale = ls["scale"]
+            loss, grads = jax.value_and_grad(
+                lambda p: mlm_loss(lowprec.cast_tree(p), inputs, targets,
+                                   weights, cfg).astype(jnp.float32)
+                * scale)(params)
+            loss = loss / scale
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            lr = _scheduled_lr(cfg, base["t"] + 1)
+            new_params, new_base = _adam_update(
+                params, grads, base, lr, weight_decay=cfg.weight_decay,
+                clip_grad_norm=cfg.clip_grad_norm)
+            params = lowprec.select_trees(finite, new_params, params)
+            base = lowprec.select_trees(finite, new_base, base)
+            ls = lowprec.advance_scale(ls, finite)
+            return params, lowprec.opt_with_scale(base, ls), loss
+
         loss, grads = jax.value_and_grad(mlm_loss)(
             params, inputs, targets, weights, cfg)
         lr = _scheduled_lr(cfg, opt["t"] + 1)
@@ -296,7 +322,34 @@ def make_finetune_step(cfg: BertConfig, n_classes: int,
         return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
                                              axis=-1))
 
+    from deeplearning4j_tpu.ops import lowprec
+
+    lp = lowprec.train_policy()
+
     def step(both, opt, tokens, labels):
+        if lp:
+            ls = lowprec.opt_scale_state(opt)
+            base = {"m": opt["m"], "v": opt["v"], "t": opt["t"]}
+            scale = ls["scale"]
+            loss, grads = jax.value_and_grad(
+                lambda b: loss_fn(lowprec.cast_tree(b), tokens, labels)
+                * scale)(both)
+            loss = loss / scale
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            lr = _scheduled_lr(cfg, base["t"] + 1)
+            new, new_base = _adam_update(
+                both, grads, base, lr, weight_decay=cfg.weight_decay,
+                clip_grad_norm=cfg.clip_grad_norm)
+            if encoder_lr_scale != 1.0:
+                new["encoder"] = jax.tree_util.tree_map(
+                    lambda old, n: old + encoder_lr_scale * (n - old),
+                    both["encoder"], new["encoder"])
+            new = lowprec.select_trees(finite, new, both)
+            base = lowprec.select_trees(finite, new_base, base)
+            ls = lowprec.advance_scale(ls, finite)
+            return new, lowprec.opt_with_scale(base, ls), loss
+
         loss, grads = jax.value_and_grad(loss_fn)(both, tokens, labels)
         lr = _scheduled_lr(cfg, opt["t"] + 1)
         new, opt = _adam_update(both, grads, opt, lr,

@@ -407,12 +407,20 @@ def loss_fn(params: Params, tokens: jax.Array, targets: jax.Array,
 
 
 def init_opt_state(params: Params) -> Params:
+    from deeplearning4j_tpu.ops import lowprec
+
     z = lambda a: jnp.zeros_like(a)
-    return {
+    opt = {
         "m": jax.tree_util.tree_map(z, params),
         "v": jax.tree_util.tree_map(z, params),
         "t": jnp.zeros((), jnp.int32),
     }
+    # bf16 loss-scaled training (DL4J_TPU_BF16): the dynamic loss-scale
+    # state rides INSIDE the opt tree — step arity, the opt-only donation
+    # contract and the save/load npz round-trip all stay unchanged
+    if lowprec.train_policy():
+        opt.update(lowprec.opt_scale_entries())
+    return opt
 
 
 def _clip_by_global_norm(grads, max_norm):
@@ -490,6 +498,19 @@ def _donation_kwargs():
     return {"donate_argnums": (1,)}
 
 
+def _reject_lowprec(path: str) -> None:
+    """The ring/pipeline step factories drop unknown opt keys (they
+    rebuild {'m','v','t'} from _adam_update), so bf16 loss scaling would
+    silently degrade to ls-less f32 there — reject loudly instead (the
+    accum_steps-under-PP pattern)."""
+    from deeplearning4j_tpu.ops import lowprec
+
+    if lowprec.train_policy():
+        raise ValueError(
+            f"DL4J_TPU_BF16 is not supported on the {path} training path "
+            "yet — unset it (the dense and accum paths support it)")
+
+
 def _validate_schedule(cfg: TransformerConfig) -> None:
     """Shared by the dense AND pipelined step factories — a cfg the dense
     path rejects loudly must never train silently through the pipeline."""
@@ -527,11 +548,34 @@ def _build_step(cfg: TransformerConfig):
     # (test_accum_moe_equals_pipelined_groups). Dense configs remain
     # exactly full-batch equivalent (mean-of-means).
     _validate_schedule(cfg)
+    from deeplearning4j_tpu.ops import lowprec
+
+    lp = lowprec.train_policy()
 
     def step(params, opt, tokens, targets):
+        if lp:
+            # bf16 master-weight mode (ops/lowprec.py): the scale rides
+            # the opt tree; the backward pass runs on the SCALED loss of
+            # the bf16-cast params, grads come back f32 via the cast's
+            # transpose and are unscaled before Adam
+            ls = lowprec.opt_scale_state(opt)
+            base = {"m": opt["m"], "v": opt["v"], "t": opt["t"]}
+            scale = ls["scale"]
+
+            def grad_loss(p, x, y):
+                return loss_fn(
+                    lowprec.cast_tree(p), x, y, cfg
+                ).astype(jnp.float32) * scale
+        else:
+            ls = None
+            base = opt
+
+            def grad_loss(p, x, y):
+                return loss_fn(p, x, y, cfg)
+
         if accum_steps == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, tokens, targets, cfg)
+            loss, grads = jax.value_and_grad(grad_loss)(
+                params, tokens, targets)
         else:
             b = tokens.shape[0]
             if b % accum_steps != 0:
@@ -543,8 +587,8 @@ def _build_step(cfg: TransformerConfig):
 
             def micro(carry, xy):
                 loss_a, grads_a = carry
-                loss_i, grads_i = jax.value_and_grad(loss_fn)(
-                    params, xy[0], xy[1], cfg)
+                loss_i, grads_i = jax.value_and_grad(grad_loss)(
+                    params, xy[0], xy[1])
                 grads_a = jax.tree_util.tree_map(
                     lambda a, g: a + g / accum_steps, grads_a, grads_i)
                 return (loss_a + loss_i / accum_steps, grads_a), None
@@ -552,6 +596,23 @@ def _build_step(cfg: TransformerConfig):
             zero = jax.tree_util.tree_map(jnp.zeros_like, params)
             (loss, grads), _ = lax.scan(
                 micro, (jnp.zeros((), jnp.float32), zero), (xs, ys))
+
+        if lp:
+            loss = loss / scale  # report the unscaled loss
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            lr = _scheduled_lr(cfg, base["t"] + 1)
+            new_params, new_base = _adam_update(
+                params, grads, base, lr,
+                weight_decay=cfg.weight_decay,
+                clip_grad_norm=cfg.clip_grad_norm)
+            params = lowprec.select_trees(finite, new_params, params)
+            # 't' is selected too: a skipped step must not advance the
+            # LR schedule or the bias correction
+            base = lowprec.select_trees(finite, new_base, base)
+            ls = lowprec.advance_scale(ls, finite)
+            return params, lowprec.opt_with_scale(base, ls), loss
+
         lr = _scheduled_lr(cfg, opt["t"] + 1)
         params, opt = _adam_update(params, grads, opt, lr,
                                    weight_decay=cfg.weight_decay,
@@ -565,8 +626,14 @@ def _mesh_shardings(cfg: TransformerConfig, mesh: Mesh):
     # param_shardings_for_mesh handles every mesh kind (Megatron when a
     # 'model'/'expert' axis exists, replicated for pure-DP meshes) — a
     # ('data',)-only mesh must not crash on a 'model' PartitionSpec
+    from deeplearning4j_tpu.ops import lowprec
+
     pshard = param_shardings_for_mesh(cfg, mesh)
     oshard = {"m": pshard, "v": pshard, "t": NamedSharding(mesh, P())}
+    if lowprec.train_policy():
+        # the loss-scale scalars ride the opt tree replicated
+        oshard.update({k: NamedSharding(mesh, P())
+                       for k in lowprec.OPT_SCALE_KEYS})
     dshard = NamedSharding(
         mesh, P(DATA_AXIS) if DATA_AXIS in mesh.shape else P())
     return pshard, oshard, dshard
@@ -816,6 +883,7 @@ def _build_ring_step(cfg, mesh, strategy):
     if cfg.accum_steps != 1:
         raise ValueError("cfg.accum_steps must be 1 under sequence-parallel "
                          "training (shard 'data' for more batch instead)")
+    _reject_lowprec("sequence-parallel")
     _validate_schedule(cfg)
 
     def sp_loss(params, tokens, targets):
@@ -1006,6 +1074,7 @@ def make_pipeline_train_step(cfg: TransformerConfig, mesh: Mesh, *,
 def _build_pipeline_step(cfg, mesh, n_micro, axis, data_axis):
     # validated HERE so every pipelined factory (single- and multi-step)
     # rejects the unsupported configs, not just make_pipeline_train_step
+    _reject_lowprec("pipelined")
     _validate_schedule(cfg)
     if cfg.accum_steps != 1:
         raise ValueError(

@@ -46,7 +46,7 @@ from deeplearning4j_tpu.nn.layers.factory import (
     create_layer,
 )
 from deeplearning4j_tpu.nn.layers.feedforward import OutputLayerImpl
-from deeplearning4j_tpu.ops import dispatch, rng as rng_mod
+from deeplearning4j_tpu.ops import dispatch, lowprec, rng as rng_mod
 from deeplearning4j_tpu.optimize.updaters import LayerUpdater, apply_updates
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -86,6 +86,8 @@ class ComputationGraph:
         self._score_dev = None
         self._rng = rng_mod.key(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
+        # bf16 loss-scaled training state (DL4J_TPU_BF16, ops/lowprec.py)
+        self._loss_scale = None
         self._input_shapes: Optional[Dict[str, Tuple[int, ...]]] = None
         self.dispatch_stats = dispatch.DispatchStats()
         from deeplearning4j_tpu.ops.memory import MemoryStats
@@ -414,8 +416,9 @@ class ComputationGraph:
 
     def _get_train_step(self, n_labels: int, has_label_masks: bool,
                         carry_state=False, backprop_window=None):
+        lp = lowprec.train_policy()
         key = ("train_step", n_labels, has_label_masks, carry_state,
-               backprop_window)
+               backprop_window, lp)
         if key in self._jit_cache:
             return self._jit_cache[key]
 
@@ -443,6 +446,9 @@ class ComputationGraph:
             params = apply_updates(params, updates, self.conf.minimize)
             return params, new_states, upd_state, loss
 
+        if lp:
+            return self._build_lowprec_step(key, carry_state, backprop_window)
+
         # donation contract as in MultiLayerNetwork._get_train_step: every
         # caller re-binds params/states/upd_state from the returned triple
         fn = dispatch.instrumented_jit(
@@ -451,15 +457,143 @@ class ComputationGraph:
         self._jit_cache[key] = fn
         return fn
 
+    def _ensure_loss_scale(self):
+        if self._loss_scale is None:
+            self._loss_scale = lowprec.init_scale_state()
+        return self._loss_scale
+
+    @property
+    def loss_scale(self):
+        """Host snapshot of the dynamic loss-scale state (None when bf16
+        training never ran); syncs dispatch_stats.loss_scale_skips."""
+        snap = lowprec.scale_snapshot(self._loss_scale)
+        if snap is not None:
+            self.dispatch_stats.loss_scale_skips = snap["skipped"]
+        return snap
+
+    def _build_lowprec_step(self, key, carry_state, backprop_window):
+        """bf16 master-weight train step for the DAG container — same
+        scaled-loss / unscale / halve-and-skip discipline as
+        MultiLayerNetwork._build_lowprec_step (Micikevicius et al., ICLR
+        2018); the inner jit takes + donates the loss-scale tree, the
+        wrapper keeps the original 9-arg signature."""
+
+        def lp_step(params, states, upd_state, ls, inputs, labels,
+                    iteration, rng, masks, label_masks):
+            scale = ls["scale"]
+
+            def loss_fn(p):
+                loss, new_states = self._loss(
+                    lowprec.cast_tree(p),
+                    states,
+                    {k: lowprec.cast_array(v) for k, v in inputs.items()}
+                    if isinstance(inputs, dict)
+                    else lowprec.cast_array(inputs),
+                    labels,
+                    train=True,
+                    rng=rng,
+                    masks=masks,
+                    label_masks=label_masks,
+                    carry_state=carry_state,
+                    backprop_window=backprop_window,
+                )
+                return loss.astype(jnp.float32) * scale, (loss, new_states)
+
+            (_, (loss, new_states)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            updates, new_upd = self._update_all(
+                grads, upd_state, params, iteration)
+            new_params = apply_updates(params, updates, self.conf.minimize)
+            params = lowprec.select_trees(finite, new_params, params)
+            upd_state = lowprec.select_trees(finite, new_upd, upd_state)
+            states = lowprec.select_trees(finite, new_states, states)
+            ls = lowprec.advance_scale(ls, finite)
+            return params, states, upd_state, ls, loss.astype(jnp.float32)
+
+        inner = dispatch.instrumented_jit(
+            lp_step, "train_step", self.dispatch_stats,
+            donate=(0, 1, 2, 3), step=True, mem_stats=self.memory_stats)
+        net = self
+
+        def wrapper(params, states, upd_state, inputs, labels, iteration,
+                    rng, masks, label_masks):
+            ls = net._ensure_loss_scale()
+            params, states, upd_state, ls, loss = inner(
+                params, states, upd_state, ls, inputs, labels, iteration,
+                rng, masks, label_masks)
+            net._loss_scale = ls
+            return params, states, upd_state, loss
+
+        def measure_memory(params, states, upd_state, inputs, labels,
+                           iteration, rng, masks, label_masks):
+            return inner.measure_memory(
+                params, states, upd_state, net._ensure_loss_scale(),
+                inputs, labels, iteration, rng, masks, label_masks)
+
+        wrapper.measure_memory = measure_memory
+        wrapper.lowprec = True
+        self._jit_cache[key] = wrapper
+        return wrapper
+
     def _get_fit_batches_fn(self, n_labels: int):
         """K train steps fused into ONE lax.scan (see
         MultiLayerNetwork._get_fit_batches_fn). Mask-free path: masked
         multi-step training uses the per-step fit()."""
-        key = ("fit_batches", n_labels)
+        lp = lowprec.train_policy()
+        key = ("fit_batches", n_labels, lp)
         if key in self._jit_cache:
             return self._jit_cache[key]
 
         n_iters = max(1, self.conf.iterations)
+
+        def one_iter(params, states, upd_state, xs_k, ys_k, it, rng):
+            def loss_fn(p):
+                return self._loss(
+                    p, states, xs_k, ys_k, train=True,
+                    rng=rng_mod.step_key(rng, it),
+                    masks=None, label_masks=None,
+                    remat_prevent_cse=False,  # scan boundary blocks CSE
+                )
+
+            (loss, states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, upd_state = self._update_all(
+                grads, upd_state, params, it
+            )
+            params = apply_updates(params, updates, self.conf.minimize)
+            return params, states, upd_state, loss
+
+        def one_iter_lp(params, states, upd_state, ls, xs_k, ys_k, it, rng):
+            # _build_lowprec_step discipline inlined into the scan body
+            scale = ls["scale"]
+
+            def loss_fn(p):
+                loss, new_states = self._loss(
+                    lowprec.cast_tree(p), states,
+                    {k: lowprec.cast_array(v) for k, v in xs_k.items()}
+                    if isinstance(xs_k, dict) else lowprec.cast_array(xs_k),
+                    ys_k, train=True,
+                    rng=rng_mod.step_key(rng, it),
+                    masks=None, label_masks=None,
+                    remat_prevent_cse=False,
+                )
+                return loss.astype(jnp.float32) * scale, (loss, new_states)
+
+            (_, (loss, new_states)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            updates, new_upd = self._update_all(
+                grads, upd_state, params, it)
+            new_params = apply_updates(params, updates, self.conf.minimize)
+            params = lowprec.select_trees(finite, new_params, params)
+            upd_state = lowprec.select_trees(finite, new_upd, upd_state)
+            states = lowprec.select_trees(finite, new_states, states)
+            ls = lowprec.advance_scale(ls, finite)
+            return params, states, upd_state, ls, loss.astype(jnp.float32)
 
         def scan_fn(params, states, upd_state, inputs, labels, it0, rng):
             def body(carry, inp):
@@ -468,21 +602,8 @@ class ComputationGraph:
 
                 iter_losses = []
                 for _ in range(n_iters):  # conf.iterations, like fit()
-                    def loss_fn(p):
-                        return self._loss(
-                            p, states, xs_k, ys_k, train=True,
-                            rng=rng_mod.step_key(rng, it),
-                            masks=None, label_masks=None,
-                            remat_prevent_cse=False,  # scan boundary blocks CSE
-                        )
-
-                    (loss, states), grads = jax.value_and_grad(
-                        loss_fn, has_aux=True
-                    )(params)
-                    updates, upd_state = self._update_all(
-                        grads, upd_state, params, it
-                    )
-                    params = apply_updates(params, updates, self.conf.minimize)
+                    params, states, upd_state, loss = one_iter(
+                        params, states, upd_state, xs_k, ys_k, it, rng)
                     it = it + 1
                     iter_losses.append(loss)
                 return (params, states, upd_state, it), jnp.stack(iter_losses)
@@ -491,6 +612,47 @@ class ComputationGraph:
                 body, (params, states, upd_state, it0), (inputs, labels)
             )
             return params, states, upd_state, losses.reshape(-1)
+
+        if lp:
+            def lp_scan_fn(params, states, upd_state, ls, inputs, labels,
+                           it0, rng):
+                def body(carry, inp):
+                    params, states, upd_state, ls, it = carry
+                    xs_k, ys_k = inp
+                    iter_losses = []
+                    for _ in range(n_iters):
+                        params, states, upd_state, ls, loss = one_iter_lp(
+                            params, states, upd_state, ls, xs_k, ys_k, it,
+                            rng)
+                        it = it + 1
+                        iter_losses.append(loss)
+                    return ((params, states, upd_state, ls, it),
+                            jnp.stack(iter_losses))
+
+                (params, states, upd_state, ls, _), losses = jax.lax.scan(
+                    body, (params, states, upd_state, ls, it0),
+                    (inputs, labels)
+                )
+                return params, states, upd_state, ls, losses.reshape(-1)
+
+            inner = dispatch.instrumented_jit(
+                lp_scan_fn, "fit_batches", self.dispatch_stats,
+                donate=(0, 1, 2, 3), step=True,
+                mem_stats=self.memory_stats)
+            net = self
+
+            def wrapper(params, states, upd_state, inputs, labels, it0,
+                        rng):
+                ls = net._ensure_loss_scale()
+                params, states, upd_state, ls, losses = inner(
+                    params, states, upd_state, ls, inputs, labels, it0,
+                    rng)
+                net._loss_scale = ls
+                return params, states, upd_state, losses
+
+            wrapper.lowprec = True
+            self._jit_cache[key] = wrapper
+            return wrapper
 
         fn = dispatch.instrumented_jit(
             scan_fn, "fit_batches", self.dispatch_stats,
@@ -1024,17 +1186,23 @@ class ComputationGraph:
 
     def training_state(self) -> Dict[str, Any]:
         """Exact-resume extras (see MultiLayerNetwork.training_state —
-        same contract for the DAG container)."""
-        return {
+        same contract for the DAG container, loss-scale state included)."""
+        st = {
             "iteration": int(self.iteration),
             "rng": np.asarray(self._rng, np.uint32).tolist(),
         }
+        snap = self.loss_scale  # property: also syncs loss_scale_skips
+        if snap is not None:
+            st["loss_scale"] = snap
+        return st
 
     def restore_training_state(self, st: Dict[str, Any]) -> None:
         if st.get("iteration") is not None:
             self.iteration = int(st["iteration"])
         if st.get("rng") is not None:
             self._rng = jnp.asarray(np.asarray(st["rng"], dtype=np.uint32))
+        if st.get("loss_scale") is not None:
+            self._loss_scale = lowprec.scale_from_snapshot(st["loss_scale"])
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

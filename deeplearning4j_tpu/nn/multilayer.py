@@ -46,7 +46,7 @@ from deeplearning4j_tpu.nn.layers.feedforward import (
     OutputLayerImpl,
     RBMImpl,
 )
-from deeplearning4j_tpu.ops import dispatch, rng as rng_mod
+from deeplearning4j_tpu.ops import dispatch, lowprec, rng as rng_mod
 from deeplearning4j_tpu.optimize.updaters import MultiLayerUpdater, apply_updates
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -70,6 +70,10 @@ class MultiLayerNetwork:
         self._rng = rng_mod.key(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
         self._input_shape: Optional[Tuple[int, ...]] = None
+        # bf16 loss-scaled training (DL4J_TPU_BF16, ops/lowprec.py):
+        # device-side {scale, good, skipped} tree, created lazily by the
+        # first lp train step and snapshotted through training_state()
+        self._loss_scale = None
         self.dispatch_stats = dispatch.DispatchStats()
         from deeplearning4j_tpu.ops.memory import MemoryStats
 
@@ -278,7 +282,9 @@ class MultiLayerNetwork:
         carry_state: bool = False,
         backprop_window: Optional[int] = None,
     ):
-        key = ("train_step", has_mask, has_label_mask, carry_state, backprop_window)
+        lp = lowprec.train_policy()
+        key = ("train_step", has_mask, has_label_mask, carry_state,
+               backprop_window, lp)
         if key in self._jit_cache:
             return self._jit_cache[key]
 
@@ -306,6 +312,9 @@ class MultiLayerNetwork:
             params = apply_updates(params, updates, self.conf.minimize)
             return params, new_states, upd_state, loss
 
+        if lp:
+            return self._build_lowprec_step(key, carry_state, backprop_window)
+
         # params/states/upd_state are donated: every caller (fit,
         # _fit_tbptt, ParallelWrapper) re-binds them from the returned
         # triple, so the superseded buffers are never re-read and the
@@ -316,6 +325,92 @@ class MultiLayerNetwork:
             donate=(0, 1, 2), step=True, mem_stats=self.memory_stats)
         self._jit_cache[key] = fn
         return fn
+
+    def _ensure_loss_scale(self):
+        if self._loss_scale is None:
+            self._loss_scale = lowprec.init_scale_state()
+        return self._loss_scale
+
+    @property
+    def loss_scale(self) -> Optional[dict]:
+        """Host snapshot of the dynamic loss-scale state (None when bf16
+        training never ran). This is a deliberate sync point — it also
+        refreshes dispatch_stats.loss_scale_skips."""
+        snap = lowprec.scale_snapshot(self._loss_scale)
+        if snap is not None:
+            self.dispatch_stats.loss_scale_skips = snap["skipped"]
+        return snap
+
+    def _build_lowprec_step(self, key, carry_state, backprop_window):
+        """bf16 master-weight train step (Micikevicius et al., ICLR 2018):
+        f32 master params + updater state; the loss closure casts params
+        and floating inputs to bf16 at the step boundary (the cast's
+        transpose returns f32 grads); the loss is SCALED before the
+        backward pass and the grads unscaled after; non-finite grads skip
+        the update (select back the previous state) and halve the scale.
+
+        The inner jit takes the loss-scale tree as a 4th donated arg; the
+        returned wrapper keeps the ORIGINAL 9-arg signature (every caller
+        — fit, _fit_tbptt, data_parallel, bench — re-binds the same
+        4-tuple), injecting/rebinding ``self._loss_scale`` itself."""
+
+        def lp_step(params, states, upd_state, ls, x, labels, iteration,
+                    rng, mask, label_mask):
+            scale = ls["scale"]
+
+            def loss_fn(p):
+                loss, new_states = self._loss(
+                    lowprec.cast_tree(p),
+                    states,
+                    lowprec.cast_array(x),
+                    labels,
+                    train=True,
+                    rng=rng,
+                    mask=mask,
+                    label_mask=label_mask,
+                    carry_state=carry_state,
+                    backprop_window=backprop_window,
+                )
+                return loss.astype(jnp.float32) * scale, (loss, new_states)
+
+            (_, (loss, new_states)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            updates, new_upd = self.updater.update(
+                grads, upd_state, params, iteration
+            )
+            new_params = apply_updates(params, updates, self.conf.minimize)
+            params = lowprec.select_trees(finite, new_params, params)
+            upd_state = lowprec.select_trees(finite, new_upd, upd_state)
+            states = lowprec.select_trees(finite, new_states, states)
+            ls = lowprec.advance_scale(ls, finite)
+            return params, states, upd_state, ls, loss.astype(jnp.float32)
+
+        inner = dispatch.instrumented_jit(
+            lp_step, "train_step", self.dispatch_stats,
+            donate=(0, 1, 2, 3), step=True, mem_stats=self.memory_stats)
+        net = self
+
+        def wrapper(params, states, upd_state, x, labels, iteration, rng,
+                    mask, label_mask):
+            ls = net._ensure_loss_scale()
+            params, states, upd_state, ls, loss = inner(
+                params, states, upd_state, ls, x, labels, iteration, rng,
+                mask, label_mask)
+            net._loss_scale = ls
+            return params, states, upd_state, loss
+
+        def measure_memory(params, states, upd_state, x, labels, iteration,
+                           rng, mask, label_mask):
+            return inner.measure_memory(
+                params, states, upd_state, net._ensure_loss_scale(), x,
+                labels, iteration, rng, mask, label_mask)
+
+        wrapper.measure_memory = measure_memory
+        wrapper.lowprec = True
+        self._jit_cache[key] = wrapper
+        return wrapper
 
     def measure_memory(self, features, labels, mask=None, label_mask=None):
         """AOT memory accounting for this net's train step on the given
@@ -466,11 +561,61 @@ class MultiLayerNetwork:
         counter, per-step rng stream) are identical to K fit() calls; the
         fusion removes the per-step host dispatch, which dominates step
         time for small/medium models on a remote-attached TPU."""
-        key = ("fit_batches", has_mask, has_label_mask)
+        lp = lowprec.train_policy()
+        key = ("fit_batches", has_mask, has_label_mask, lp)
         if key in self._jit_cache:
             return self._jit_cache[key]
 
         n_iters = max(1, self.conf.iterations)
+
+        def one_iter(params, states, upd_state, x, y, it, rng, mask, lmask):
+            def loss_fn(p):
+                return self._loss(
+                    p, states, x, y, train=True,
+                    rng=rng_mod.step_key(rng, it),
+                    mask=mask, label_mask=lmask,
+                    # inside lax.scan the loop boundary already
+                    # prevents CSE; skip the remat barriers
+                    remat_prevent_cse=False,
+                )
+
+            (loss, states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, upd_state = self.updater.update(
+                grads, upd_state, params, it
+            )
+            params = apply_updates(params, updates, self.conf.minimize)
+            return params, states, upd_state, loss
+
+        def one_iter_lp(params, states, upd_state, ls, x, y, it, rng,
+                        mask, lmask):
+            # same scaled-loss/unscale/skip discipline as
+            # _build_lowprec_step, inlined into the scan body
+            scale = ls["scale"]
+
+            def loss_fn(p):
+                loss, new_states = self._loss(
+                    lowprec.cast_tree(p), states, lowprec.cast_array(x), y,
+                    train=True, rng=rng_mod.step_key(rng, it),
+                    mask=mask, label_mask=lmask,
+                    remat_prevent_cse=False,
+                )
+                return loss.astype(jnp.float32) * scale, (loss, new_states)
+
+            (_, (loss, new_states)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = lowprec.unscale(grads, scale)
+            finite = lowprec.finite_tree(grads)
+            updates, new_upd = self.updater.update(
+                grads, upd_state, params, it
+            )
+            new_params = apply_updates(params, updates, self.conf.minimize)
+            params = lowprec.select_trees(finite, new_params, params)
+            upd_state = lowprec.select_trees(finite, new_upd, upd_state)
+            states = lowprec.select_trees(finite, new_states, states)
+            ls = lowprec.advance_scale(ls, finite)
+            return params, states, upd_state, ls, loss.astype(jnp.float32)
 
         def scan_fn(params, states, upd_state, xs, ys, it0, rng, masks, lmasks):
             def body(carry, inp):
@@ -484,23 +629,9 @@ class MultiLayerNetwork:
                 # like fit()'s Solver loop (statically unrolled)
                 iter_losses = []
                 for _ in range(n_iters):
-                    def loss_fn(p):
-                        return self._loss(
-                            p, states, x, y, train=True,
-                            rng=rng_mod.step_key(rng, it),
-                            mask=mask, label_mask=lmask,
-                            # inside lax.scan the loop boundary already
-                            # prevents CSE; skip the remat barriers
-                            remat_prevent_cse=False,
-                        )
-
-                    (loss, states), grads = jax.value_and_grad(
-                        loss_fn, has_aux=True
-                    )(params)
-                    updates, upd_state = self.updater.update(
-                        grads, upd_state, params, it
-                    )
-                    params = apply_updates(params, updates, self.conf.minimize)
+                    params, states, upd_state, loss = one_iter(
+                        params, states, upd_state, x, y, it, rng, mask,
+                        lmask)
                     it = it + 1
                     iter_losses.append(loss)
                 return (params, states, upd_state, it), jnp.stack(iter_losses)
@@ -512,6 +643,52 @@ class MultiLayerNetwork:
                 body, (params, states, upd_state, it0), inputs
             )
             return params, states, upd_state, losses.reshape(-1)
+
+        if lp:
+            def lp_scan_fn(params, states, upd_state, ls, xs, ys, it0, rng,
+                           masks, lmasks):
+                def body(carry, inp):
+                    params, states, upd_state, ls, it = carry
+                    x = inp[0]
+                    y = inp[1]
+                    mask = inp[2] if has_mask else None
+                    lmask = inp[3] if has_label_mask else None
+                    iter_losses = []
+                    for _ in range(n_iters):
+                        params, states, upd_state, ls, loss = one_iter_lp(
+                            params, states, upd_state, ls, x, y, it, rng,
+                            mask, lmask)
+                        it = it + 1
+                        iter_losses.append(loss)
+                    return ((params, states, upd_state, ls, it),
+                            jnp.stack(iter_losses))
+
+                zeros = jnp.zeros((xs.shape[0],), jnp.float32)
+                inputs = (xs, ys, masks if has_mask else zeros,
+                          lmasks if has_label_mask else zeros)
+                (params, states, upd_state, ls, _), losses = jax.lax.scan(
+                    body, (params, states, upd_state, ls, it0), inputs
+                )
+                return params, states, upd_state, ls, losses.reshape(-1)
+
+            inner = dispatch.instrumented_jit(
+                lp_scan_fn, "fit_batches", self.dispatch_stats,
+                donate=(0, 1, 2, 3), step=True,
+                mem_stats=self.memory_stats)
+            net = self
+
+            def wrapper(params, states, upd_state, xs, ys, it0, rng,
+                        masks, lmasks):
+                ls = net._ensure_loss_scale()
+                params, states, upd_state, ls, losses = inner(
+                    params, states, upd_state, ls, xs, ys, it0, rng,
+                    masks, lmasks)
+                net._loss_scale = ls
+                return params, states, upd_state, losses
+
+            wrapper.lowprec = True
+            self._jit_cache[key] = wrapper
+            return wrapper
 
         # same donation contract as the train step: fit_batches re-binds
         # params/states/upd_state from the scan's outputs
@@ -970,11 +1147,17 @@ class MultiLayerNetwork:
         stream and every LR schedule fold it in) and the base RNG key. The
         reference's ModelSerializer drops both (ModelSerializer.java:70-110
         writes config+coefficients+updater only), which is why a restored
-        reference run drifts from the uninterrupted one."""
-        return {
+        reference run drifts from the uninterrupted one. Under bf16
+        training (DL4J_TPU_BF16) the dynamic loss-scale state rides along
+        so kill/resume keeps the exact scale/skip trajectory."""
+        st = {
             "iteration": int(self.iteration),
             "rng": np.asarray(self._rng, np.uint32).tolist(),
         }
+        snap = self.loss_scale  # property: also syncs loss_scale_skips
+        if snap is not None:
+            st["loss_scale"] = snap
+        return st
 
     def restore_training_state(self, st: Dict[str, Any]) -> None:
         """Inverse of :meth:`training_state`; tolerant of partial dicts so
@@ -983,6 +1166,8 @@ class MultiLayerNetwork:
             self.iteration = int(st["iteration"])
         if st.get("rng") is not None:
             self._rng = jnp.asarray(np.asarray(st["rng"], dtype=np.uint32))
+        if st.get("loss_scale") is not None:
+            self._loss_scale = lowprec.scale_from_snapshot(st["loss_scale"])
 
     # ------------------------------------------------------------- listeners
     def set_listeners(self, *listeners):

@@ -175,6 +175,14 @@ class DispatchStats:
                      fit_batches calls that fell back to per-step fits
                      under the fusion policy (fusion_enabled: the
                      XLA:CPU scan-of-conv pessimization guard)
+      loss_scale_skips
+                     bf16 loss-scaled training (DL4J_TPU_BF16 /
+                     ops/lowprec.py): optimizer steps SKIPPED on
+                     non-finite grads (the halve-and-skip half of
+                     dynamic loss scaling). Refreshed at explicit sync
+                     points (training_state() / net.loss_scale), never
+                     per step — reading it per step would be a hidden
+                     device sync.
     """
 
     def __init__(self) -> None:
@@ -186,6 +194,7 @@ class DispatchStats:
         self.padded_batches = 0
         self.padded_examples = 0
         self.fused_fallbacks = 0
+        self.loss_scale_skips = 0
 
     def cache_hits(self, name: Optional[str] = None) -> int:
         if name is not None:
@@ -204,6 +213,7 @@ class DispatchStats:
             "padded_batches": self.padded_batches,
             "padded_examples": self.padded_examples,
             "fused_fallbacks": self.fused_fallbacks,
+            "loss_scale_skips": self.loss_scale_skips,
         }
 
 

@@ -120,6 +120,33 @@ _register("DL4J_TPU_PALLAS_SGNS", "", "enum",
           "on even off-TPU (interpret-mode tests)",
           choices=("", "0", "false", "False", "force"))
 
+# low-precision plane (ops/lowprec.py + etl/calibrate.py)
+_register("DL4J_TPU_QUANT", "", "enum",
+          "calibrated int8 serving: '' auto (quantize when the model zip "
+          "carries quant.json AND the accuracy gate passes), 0 off, force "
+          "(quantize even when the gate delta exceeds the bar — delta "
+          "still measured and reported)",
+          choices=("", "0", "off", "force"))
+_register("DL4J_TPU_QUANT_MAX_DELTA", "0.05", "float",
+          "int8 accuracy gate: max abs output delta vs the f32 record "
+          "measured at registry load on the calibration gate sample; past "
+          "it the record lands BROKEN (PR 8 isolation) and the serving "
+          "default never moves")
+_register("DL4J_TPU_BF16", "0", "bool",
+          "bf16 master-weight training mode for the containers and "
+          "TransformerLM/BertMLM: f32 master params + updater state, bf16 "
+          "cast at the train-step boundary, dynamic loss scaling "
+          "(halve-and-skip on non-finite grads)")
+_register("DL4J_TPU_LOSS_SCALE", "", "str",
+          "dynamic loss-scale policy 'init' or 'init:growth_interval' "
+          "('' = 32768:2000: start at 2^15, double after 2000 clean "
+          "steps, halve-and-skip on non-finite grads, floor 1)")
+_register("DL4J_TPU_SERVE_KV_DTYPE", "", "enum",
+          "paged-KV arena dtype: '' = the model's compute dtype, bf16 "
+          "halves KV bytes (same DL4J_TPU_HBM_GB admits ~2x tokens), f32 "
+          "forces full precision",
+          choices=("", "bf16", "f32"))
+
 # observability (obs/)
 _register("DL4J_TPU_OBS", "0", "bool",
           "span tracer master switch (default OFF; obs off => training "

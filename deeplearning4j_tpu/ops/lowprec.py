@@ -1,0 +1,404 @@
+"""Low-precision plane: bf16 loss-scaled training + calibrated int8 serving.
+
+Two classic recipes, mapped onto the planes the repo already has (the
+reference treats dtype as one global ND4J switch — ``Nd4j.dtype`` /
+DataBuffer.Type in nd4j-api — with no calibration or accuracy story):
+
+* **bf16 master-weight training** (Micikevicius et al., ICLR 2018 — mixed
+  precision with master weights + dynamic loss scaling): f32 master params
+  and updater state stay the source of truth; the train step casts params
+  (and floating inputs) to bf16 at the step boundary, computes the loss
+  scaled by a dynamic power-of-two factor, unscales the f32 grads, and
+  SKIPS the update (halving the scale) when any grad is non-finite. The
+  scale doubles again after ``growth_interval`` clean steps. All of it is
+  traced into the one whole-step jit, so it composes with donation,
+  bucketing, remat and accum_steps unchanged. Distinct from the
+  ``DL4J_TPU_STRICT_CONV=3pass`` bf16 hi/lo SPLIT (ops/precision.py), which
+  is an f32-accuracy EMULATION technique — this plane genuinely computes in
+  bf16 and pays for it with loss scaling.
+
+* **calibrated int8 inference** (Jacob et al., CVPR 2018 — integer-only
+  inference with per-channel symmetric scales): per-output-channel weight
+  scales from max|W|, per-tensor activation scales from a streaming-absmax
+  calibration pass (etl/calibrate.QuantCalibrator), an int8 matmul with
+  int32 accumulation dequantized back to f32 for bias + activation.
+  :class:`QuantizedNet` wraps a container's inference path layer by layer,
+  falling back to the full-precision apply for unsupported layers, so a
+  conv stack serves with a quantized dense head and nothing breaks.
+
+Knobs (ops/env.py): DL4J_TPU_BF16, DL4J_TPU_LOSS_SCALE, DL4J_TPU_QUANT,
+DL4J_TPU_QUANT_MAX_DELTA, DL4J_TPU_SERVE_KV_DTYPE.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops import env
+
+__all__ = [
+    "train_policy", "loss_scale_config", "init_scale_state", "cast_tree",
+    "cast_array", "finite_tree", "unscale", "select_trees", "advance_scale",
+    "scale_snapshot", "scale_from_snapshot", "OPT_SCALE_KEYS",
+    "opt_scale_entries", "opt_scale_state", "opt_with_scale",
+    "quant_mode", "quant_max_delta", "quantize_weight", "int8_dense",
+    "QuantizedNet", "QuantGateError", "kv_dtype", "precision_of",
+]
+
+# ---------------------------------------------------------------------------
+# bf16 master-weight training policy
+# ---------------------------------------------------------------------------
+
+_DEFAULT_SCALE = 32768.0      # 2^15 — the Micikevicius et al. starting point
+_DEFAULT_GROWTH = 2000       # clean steps before the scale doubles
+
+
+def train_policy() -> bool:
+    """True when bf16 loss-scaled training is on. Read at TRACE time (the
+    DL4J_TPU_REMAT pattern): the returned value is baked into the step
+    program; flipping the knob mid-process retraces via the jit cache
+    key."""
+    return env.get_bool("DL4J_TPU_BF16")
+
+
+def loss_scale_config() -> Tuple[float, int]:
+    """(initial_scale, growth_interval) from DL4J_TPU_LOSS_SCALE — 'init'
+    or 'init:growth_interval'; garbage falls back per the env-table
+    contract."""
+    spec = env.get_str("DL4J_TPU_LOSS_SCALE") or ""
+    init, growth = _DEFAULT_SCALE, _DEFAULT_GROWTH
+    if spec:
+        head, _, tail = spec.partition(":")
+        try:
+            init = float(head)
+        except ValueError:
+            init = _DEFAULT_SCALE
+        if tail:
+            try:
+                growth = int(tail)
+            except ValueError:
+                growth = _DEFAULT_GROWTH
+    return max(init, 1.0), max(growth, 1)
+
+
+def init_scale_state() -> dict:
+    """Fresh device-side loss-scale state: the scale itself plus the
+    clean-step and skip counters. Rides the train step as ONE donated
+    pytree so no per-step host sync ever reads it; checkpoints snapshot it
+    through the containers' training_state()."""
+    init, _ = loss_scale_config()
+    return {
+        "scale": jnp.asarray(init, jnp.float32),
+        "good": jnp.zeros((), jnp.int32),
+        "skipped": jnp.zeros((), jnp.int32),
+    }
+
+
+def cast_array(x):
+    """bf16 cast for floating arrays only — int token/label inputs pass
+    through untouched."""
+    if isinstance(x, (jax.Array, np.ndarray)) and jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.floating):
+        return jnp.asarray(x, jnp.bfloat16)
+    return x
+
+
+def cast_tree(tree, dtype=jnp.bfloat16):
+    """Cast every floating leaf to ``dtype`` (master-weight boundary cast:
+    grads flow back f32 through the cast's transpose)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+        tree)
+
+
+def finite_tree(tree) -> jax.Array:
+    """Scalar bool: every floating leaf all-finite (the overflow vote the
+    skip decision keys on)."""
+    ok = jnp.asarray(True)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def unscale(grads, scale):
+    """grads / scale in f32 — exact for the power-of-two scales the
+    dynamic policy produces."""
+    inv = (1.0 / scale).astype(jnp.float32)
+    return jax.tree_util.tree_map(
+        lambda g: g.astype(jnp.float32) * inv, grads)
+
+
+def select_trees(pred, new, old):
+    """Elementwise where over two same-structure trees: commit the step's
+    outputs when ``pred`` (grads finite) else keep the previous state —
+    the halve-and-skip path never lets a NaN reach the master weights."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(pred, n.astype(o.dtype), o), new, old)
+
+
+def advance_scale(ls: dict, finite) -> dict:
+    """One dynamic-loss-scale transition: clean step bumps the good
+    counter (doubling the scale each ``growth_interval``); a non-finite
+    step halves the scale (floor 1) and bumps the skip counter."""
+    _, growth = loss_scale_config()
+    good = jnp.where(finite, ls["good"] + 1, 0)
+    grow = good >= growth
+    scale = jnp.where(
+        finite,
+        jnp.where(grow, ls["scale"] * 2.0, ls["scale"]),
+        jnp.maximum(ls["scale"] * 0.5, 1.0))
+    return {
+        "scale": scale.astype(jnp.float32),
+        "good": jnp.where(grow, 0, good).astype(jnp.int32),
+        "skipped": (ls["skipped"] + jnp.where(finite, 0, 1)).astype(
+            jnp.int32),
+    }
+
+
+def scale_snapshot(ls: Optional[dict]) -> Optional[dict]:
+    """Host-side JSON-able view (ONE bulk readback — this is a sync point;
+    callers are the checkpoint path and the explicit loss_scale
+    property, never the step loop)."""
+    if ls is None:
+        return None
+    return {
+        "scale": float(np.asarray(ls["scale"])),
+        "good": int(np.asarray(ls["good"])),
+        "skipped": int(np.asarray(ls["skipped"])),
+    }
+
+
+def scale_from_snapshot(st: dict) -> dict:
+    return {
+        "scale": jnp.asarray(float(st["scale"]), jnp.float32),
+        "good": jnp.asarray(int(st["good"]), jnp.int32),
+        "skipped": jnp.asarray(int(st["skipped"]), jnp.int32),
+    }
+
+
+# -- flagship models ride the loss-scale state INSIDE the opt tree ---------
+# (keeps the step arity, the donation contract and the save/load npz
+# round-trip unchanged: transformer/bert init_opt_state add these keys
+# when the policy is on, and the step reads them back out)
+
+OPT_SCALE_KEYS = ("loss_scale", "ls_good", "ls_skipped")
+
+
+def opt_scale_entries() -> dict:
+    ls = init_scale_state()
+    return {"loss_scale": ls["scale"], "ls_good": ls["good"],
+            "ls_skipped": ls["skipped"]}
+
+
+def opt_scale_state(opt: dict) -> dict:
+    return {"scale": opt["loss_scale"], "good": opt["ls_good"],
+            "skipped": opt["ls_skipped"]}
+
+
+def opt_with_scale(opt: dict, ls: dict) -> dict:
+    out = dict(opt)
+    out.update({"loss_scale": ls["scale"], "ls_good": ls["good"],
+                "ls_skipped": ls["skipped"]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# int8 quantized inference
+# ---------------------------------------------------------------------------
+
+
+class QuantGateError(RuntimeError):
+    """Measured int8 accuracy delta exceeded DL4J_TPU_QUANT_MAX_DELTA —
+    raised inside ModelRegistry.load's try block so the record lands
+    BROKEN and the serving default never moves (PR 8 isolation)."""
+
+
+def quant_mode() -> str:
+    """'off' | 'auto' | 'force' from DL4J_TPU_QUANT ('' = auto: quantize
+    when quant.json is present and the gate passes)."""
+    v = (env.raw("DL4J_TPU_QUANT") or "").strip().lower()
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v == "force":
+        return "force"
+    return "auto"
+
+
+def quant_max_delta() -> float:
+    return float(env.get_float("DL4J_TPU_QUANT_MAX_DELTA") or 0.05)
+
+
+def quantize_weight(w) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-OUTPUT-channel symmetric int8 quantization of a [in, out]
+    weight matrix (Jacob et al. per-channel scheme): scale[j] =
+    max|W[:, j]| / 127, W_q = round(W / scale). Deterministic — recomputed
+    from the f32 record at load, never serialized."""
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    wq = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return wq, scale.astype(jnp.float32)
+
+
+def int8_dense(x, wq, w_scale, x_scale, b=None):
+    """Quantized dense: int8 x int8 matmul with int32 accumulation,
+    dequantized to f32 by the product of the activation scale and the
+    per-channel weight scale, bias added in f32. Accepts [..., in]
+    inputs (the RnnOutput 3d case reshapes through the same kernel)."""
+    x = jnp.asarray(x)
+    lead = x.shape[:-1]
+    x2 = x.reshape((-1, x.shape[-1])).astype(jnp.float32)
+    xq = jnp.clip(jnp.round(x2 / x_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (jnp.asarray(x_scale, jnp.float32)
+                                   * w_scale)
+    if b is not None:
+        y = y + jnp.asarray(b, jnp.float32)
+    return y.reshape(lead + (y.shape[-1],))
+
+
+def _supported_dense(layer) -> bool:
+    """Dense-family layers the int8 path covers: plain Dense and the
+    Output/RnnOutput heads (x @ W + b with an elementwise activation).
+    Everything else (conv, subsampling, BN, recurrent, embedding) falls
+    back to the f32 apply per layer."""
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        DenseLayerImpl,
+    )
+
+    return type(layer).__name__ in (
+        "DenseLayerImpl", "OutputLayerImpl", "RnnOutputLayerImpl",
+    ) and isinstance(layer, DenseLayerImpl)
+
+
+class QuantizedNet:
+    """int8 inference wrapper for a MultiLayerNetwork: mirrors the net's
+    inference forward (preprocessors included) but routes every supported
+    dense-family layer through :func:`int8_dense` with calibrated
+    activation scales; unsupported layers run their normal f32 apply.
+    Exposes the container's serving surface (``output``, ``states``,
+    ``params``, ``dispatch_stats``) so the registry/warmup/batcher treat
+    it exactly like the f32 model it wraps.
+
+    The reference's closest analog is the global ND4J dtype switch
+    (SURVEY.md section on nd4j DataBuffer types) — no per-layer fallback,
+    no calibration; this class is the beyond-parity form."""
+
+    precision = "int8"
+
+    def __init__(self, net, spec):
+        from deeplearning4j_tpu.ops import dispatch
+
+        self.base = net
+        self.spec = spec
+        scales = list(spec.act_scales)
+        if len(scales) < len(net.layers):
+            scales += [None] * (len(net.layers) - len(scales))
+        quant: List[Optional[dict]] = []
+        for i, layer in enumerate(net.layers):
+            sc = scales[i]
+            p = net.params[i] if net.params is not None else None
+            if (sc is None or not sc or p is None or "W" not in p
+                    or not _supported_dense(layer)):
+                quant.append(None)
+                continue
+            wq, w_scale = quantize_weight(p["W"])
+            quant.append({
+                "wq": wq, "w_scale": w_scale,
+                "x_scale": jnp.asarray(float(sc), jnp.float32),
+                "b": jnp.asarray(p["b"], jnp.float32) if "b" in p else None,
+            })
+        # .params holds EVERY device buffer this wrapper can reach so the
+        # registry's unload sweep (_BUFFER_ATTRS) deletes the quantized
+        # tables and the wrapped f32 tree alike
+        self.params = {"base": net.params, "quant": quant}
+        self.states = net.states
+        self.dispatch_stats = dispatch.DispatchStats()
+        self._out_fn = None
+        from deeplearning4j_tpu.obs.registry import register_net
+
+        register_net(self)
+
+    def quantized_layers(self) -> List[int]:
+        return [i for i, q in enumerate(self.params["quant"])
+                if q is not None]
+
+    def _forward_quant(self, base_params, quant, states, x):
+        net = self.base
+        batch_n = x.shape[0]
+        for i, layer in enumerate(net.layers):
+            x = net._apply_preprocessor(i, x, batch_n)
+            q = quant[i]
+            if q is None:
+                x, _ = layer.apply(base_params[i], states[i], x,
+                                   train=False)
+            else:
+                z = int8_dense(x, q["wq"], q["w_scale"], q["x_scale"],
+                               q["b"])
+                x = layer.act(z)
+        return x
+
+    def _get_output_fn(self):
+        from deeplearning4j_tpu.ops import dispatch
+
+        if self._out_fn is None:
+            def out_fn(params, states, x):
+                return self._forward_quant(
+                    params["base"], params["quant"], states, x)
+
+            self._out_fn = dispatch.instrumented_jit(
+                out_fn, "output_int8", self.dispatch_stats)
+        return self._out_fn
+
+    def output(self, x):
+        """Quantized batch inference with the container's bucket-padding
+        discipline (MultiLayerNetwork.output): inference padding is
+        unconditionally safe, and sharing the bucket ladder keeps the
+        warmup-compiled programs hot."""
+        from deeplearning4j_tpu.ops import dispatch
+
+        fn = self._get_output_fn()
+        x = jnp.asarray(x)
+        n = x.shape[0]
+        target = dispatch.inference_bucket(self.dispatch_stats, n)
+        if target is not None:
+            return fn(self.params, self.states,
+                      dispatch.pad_axis0(x, target))[:n]
+        return fn(self.params, self.states, x)
+
+
+# ---------------------------------------------------------------------------
+# serving-plane helpers
+# ---------------------------------------------------------------------------
+
+
+def kv_dtype(cfg) -> Any:
+    """Paged-KV arena dtype: DL4J_TPU_SERVE_KV_DTYPE overrides, '' defers
+    to the model's compute dtype. bf16 halves kv_block_bytes so the same
+    DL4J_TPU_HBM_GB budget admits ~2x the tokens."""
+    v = (env.get_str("DL4J_TPU_SERVE_KV_DTYPE") or "").strip().lower()
+    if v == "bf16":
+        return jnp.bfloat16
+    if v == "f32":
+        return jnp.float32
+    return getattr(cfg, "compute_dtype", jnp.float32)
+
+
+def precision_of(model) -> str:
+    """Active serving precision label for /models and /metrics: 'int8'
+    for a QuantizedNet, 'bf16' when the model computes in bf16, else
+    'f32'."""
+    if getattr(model, "precision", None) == "int8":
+        return "int8"
+    cd = getattr(getattr(model, "cfg", None), "compute_dtype", None)
+    if cd is not None and jnp.dtype(cd) == jnp.dtype(jnp.bfloat16):
+        return "bf16"
+    return "f32"

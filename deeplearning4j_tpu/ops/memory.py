@@ -203,9 +203,15 @@ def transformer_preflight(cfg, batch: int, *, accum_steps: int = 1,
     if batch % accum_steps:
         raise ValueError(f"batch {batch} not divisible by accum_steps "
                          f"{accum_steps}")
+    from deeplearning4j_tpu.ops import lowprec
+
     budget_gb = hbm_budget_gb() if hbm_gb is None else float(hbm_gb)
     seq = cfg.max_len
-    ib = 2 if cfg.dtype_policy == "performance" else 4
+    # bf16 activations under the performance dtype policy OR bf16
+    # master-weight training (DL4J_TPU_BF16 casts at the step boundary,
+    # so the residuals the backward keeps are bf16 either way)
+    bf16_acts = cfg.dtype_policy == "performance" or lowprec.train_policy()
+    ib = 2 if bf16_acts else 4
     L = cfg.n_layers
 
     p_shapes = jax.eval_shape(lambda: init_params(cfg))
@@ -239,6 +245,7 @@ def transformer_preflight(cfg, batch: int, *, accum_steps: int = 1,
         "batch": batch,
         "accum_steps": accum_steps,
         "remat": policy,
+        "train_dtype": "bf16" if bf16_acts else "f32",
         "estimate": "analytic",
     }
 
@@ -322,19 +329,26 @@ def auto_fit_transformer(cfg, *, batches=(32, 16, 8, 4),
 # ---------------------------------------------------------------------------
 
 
-def kv_block_bytes(cfg, block_tokens: int) -> int:
+def kv_block_bytes(cfg, block_tokens: int, dtype=None) -> int:
     """Device bytes of ONE paged KV block across all layers: K and V,
-    [n_layers, block_tokens, n_heads, head_dim] each, in the model's
-    compute dtype (serving/paged.py's arena layout)."""
+    [n_layers, block_tokens, n_heads, head_dim] each, in the arena dtype
+    (serving/paged.py's layout). ``dtype=None`` resolves through
+    ops/lowprec.kv_dtype — the model's compute dtype unless
+    ``DL4J_TPU_SERVE_KV_DTYPE`` overrides it (bf16 halves KV bytes, so
+    the same HBM budget admits ~2x tokens)."""
+    from deeplearning4j_tpu.ops import lowprec
+
+    if dtype is None:
+        dtype = lowprec.kv_dtype(cfg)
     hd = cfg.d_model // cfg.n_heads
-    itemsize = np.dtype(cfg.compute_dtype).itemsize
+    itemsize = np.dtype(dtype).itemsize
     return 2 * cfg.n_layers * int(block_tokens) * cfg.n_heads * hd * itemsize
 
 
 def kv_arena_blocks(cfg, block_tokens: int, *, params=None,
                     hbm_gb: Optional[float] = None,
                     kv_fraction: float = 0.5,
-                    max_blocks: int = 4096) -> int:
+                    max_blocks: int = 4096, dtype=None) -> int:
     """How many KV blocks the arena can afford under ``DL4J_TPU_HBM_GB``.
 
     Budget = HBM minus twice the parameter bytes (weights resident plus
@@ -349,7 +363,7 @@ def kv_arena_blocks(cfg, block_tokens: int, *, params=None,
     budget = (hbm_gb if hbm_gb is not None else hbm_budget_gb()) * 2.0**30
     if params is not None:
         budget -= 2.0 * _tree_bytes(params)
-    per_block = kv_block_bytes(cfg, block_tokens)
+    per_block = kv_block_bytes(cfg, block_tokens, dtype)
     blocks = int(max(0.0, budget) * float(kv_fraction) / per_block)
     floor = cfg.max_len // int(block_tokens) + 1
     return max(floor, min(int(max_blocks), blocks))

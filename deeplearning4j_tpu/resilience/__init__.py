@@ -16,6 +16,8 @@ from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     FleetChaosConfig,
     InjectedKill,
     InjectedServingFault,
+    LowPrecChaos,
+    LowPrecChaosConfig,
     ReplicaPartitioned,
     RouterChaos,
     RouterChaosConfig,

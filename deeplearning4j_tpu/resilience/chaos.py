@@ -478,6 +478,53 @@ class RouterChaos:
             return str(c["replica"])
 
 
+@dataclass
+class LowPrecChaosConfig:
+    """Declarative overflow plan for the bf16 loss-scaling contract
+    (ops/lowprec.py): poison the FEATURES of step ``overflow_at_step``
+    (1-based) so the backward pass produces non-finite grads and the
+    dynamic loss scale must halve-and-skip. Config-driven, never ambient
+    — the test loop calls :meth:`LowPrecChaos.poison` explicitly."""
+
+    overflow_at_step: Optional[int] = None
+    mode: str = "inf"  # "inf" | "nan"
+    count: int = 1     # consecutive poisoned steps from overflow_at_step
+
+    def __post_init__(self):
+        if self.mode not in ("inf", "nan"):
+            raise ValueError(f"unknown overflow mode {self.mode!r}")
+        if self.count < 1:
+            raise ValueError("count must be >= 1")
+
+
+class LowPrecChaos:
+    """Stateful executor of a :class:`LowPrecChaosConfig` (the ChaosMonkey
+    shape). Deterministic: poisons element [0, ...] of the feature batch
+    for the configured step window, leaves every other step untouched."""
+
+    def __init__(self, config: LowPrecChaosConfig):
+        if isinstance(config, dict):
+            config = LowPrecChaosConfig(**config)
+        self.config = config
+        self.log: list = []  # (step, fault) audit trail for tests
+
+    def poison(self, step: int, features):
+        """`step` is the 1-based index of the step about to run. Returns
+        the features to feed it (a poisoned COPY on fault steps — the
+        caller's array is never mutated)."""
+        c = self.config
+        if (c.overflow_at_step is None
+                or not (c.overflow_at_step <= step
+                        < c.overflow_at_step + c.count)):
+            return features
+        import numpy as np
+
+        bad = np.array(features, dtype=np.float32, copy=True)
+        bad.reshape(-1)[0] = np.inf if c.mode == "inf" else np.nan
+        self.log.append((step, f"overflow:{c.mode}"))
+        return bad
+
+
 def truncate_file(path: str, keep: int = 16) -> None:
     """Write-then-truncate fault: keep only the first `keep` bytes (a
     crash mid-write that an atomic rename would normally prevent —

@@ -80,6 +80,7 @@ from deeplearning4j_tpu.models.transformer import (
 )
 from deeplearning4j_tpu.obs import trace as obs_trace
 from deeplearning4j_tpu.ops import dispatch
+from deeplearning4j_tpu.ops import lowprec
 from deeplearning4j_tpu.ops import memory as opsmem
 from deeplearning4j_tpu.ops import pallas_paged
 from deeplearning4j_tpu.serving.batcher import (
@@ -101,6 +102,12 @@ def attention_path(cfg: TransformerConfig, block_tokens: int) -> str:
     the dense ``ck[tables]`` fallback. Resolved at trace time; the tick
     cache keys on it, and the serving_decode bench stamps it."""
     hd = cfg.d_model // cfg.n_heads
+    if jnp.dtype(lowprec.kv_dtype(cfg)) != jnp.dtype(cfg.compute_dtype):
+        # a down-cast KV arena (DL4J_TPU_SERVE_KV_DTYPE=bf16 on an f32
+        # model) takes the gather path, which casts blocks to f32 for
+        # the attention math; the pallas kernel's bench verdicts were
+        # measured at the compute dtype
+        return "gather"
     if pallas_paged.paged_kernel_enabled(cfg.n_heads, hd, block_tokens):
         return "kernel"
     return "gather"
@@ -418,8 +425,12 @@ class PagedDecoder:
             bt //= 2
         self.block_tokens = bt
         self.table_width = cfg.max_len // bt
+        # arena dtype (DL4J_TPU_SERVE_KV_DTYPE): bf16 halves block bytes,
+        # so the auto-sized arena admits ~2x tokens on the same budget
+        self.kv_dtype = jnp.dtype(lowprec.kv_dtype(cfg))
         if n_blocks is None:
-            n_blocks = opsmem.kv_arena_blocks(cfg, bt, params=lm.params)
+            n_blocks = opsmem.kv_arena_blocks(cfg, bt, params=lm.params,
+                                              dtype=self.kv_dtype)
         self.n_blocks = int(n_blocks)
         if self.n_blocks < self.table_width + 1:
             raise ValueError(
@@ -479,9 +490,10 @@ class PagedDecoder:
         shape = (cfg.n_layers, self.n_blocks + 1, self.block_tokens,
                  cfg.n_heads, hd)
         # two distinct buffers: k and v donate separately and must not
-        # alias each other
-        self._arena = {"k": jnp.zeros(shape, cfg.compute_dtype),
-                       "v": jnp.zeros(shape, cfg.compute_dtype)}
+        # alias each other; the scatter in paged_decode_step casts k/v
+        # onto ck.dtype, so a bf16 arena under an f32 model just works
+        self._arena = {"k": jnp.zeros(shape, self.kv_dtype),
+                       "v": jnp.zeros(shape, self.kv_dtype)}
         self._blocks = BlockArena(self.n_blocks)
         self._prefix = PrefixCache(self._blocks)
         self.stats.set_kv_blocks(0, self.n_blocks)
@@ -496,6 +508,7 @@ class PagedDecoder:
                 for i, st in enumerate(self._slots) if st is not None)
         return {
             "scheme": "paged",
+            "kv_dtype": str(self.kv_dtype),
             "block_tokens": self.block_tokens,
             "blocks_total": self.n_blocks,
             "blocks_in_use": in_use,

@@ -68,6 +68,11 @@ class ModelRecord:
         # /predict request for this record — the training-time statistics
         # travel WITH the model (checkpoint zip normalizer.json section)
         self.normalizer = normalizer
+        # active serving precision ('f32'/'bf16'/'int8') + the int8
+        # accuracy-gate evidence measured at load (ISSUE 15) — the audit
+        # trail a fleet rollout of a quantized model reads at /models
+        self.precision = "f32"
+        self.quant: Optional[Dict[str, Any]] = None
         self.state = "loaded"
         self.error: Optional[str] = None  # set when state == "broken"
         self.loaded_ts = time.strftime("%Y-%m-%dT%H:%M:%S")
@@ -89,7 +94,10 @@ class ModelRecord:
             else None,
             "loaded_ts": self.loaded_ts,
             "warmed_buckets": list(self.warmed_buckets),
+            "precision": self.precision,
         }
+        if self.quant is not None:
+            out["quant"] = dict(self.quant)
         if self.error is not None:
             out["error"] = self.error
         if self.input_shape:
@@ -143,12 +151,19 @@ class ModelRegistry:
 
     # -- lifecycle --------------------------------------------------------
     def load(self, name: str, model=None, model_path: Optional[str] = None,
-             input_shape=None, normalizer=None) -> ModelRecord:
+             input_shape=None, normalizer=None, quant=None) -> ModelRecord:
         """Register a live model or restore a ModelSerializer zip; the
         version is auto-assigned (monotonic per name, starting at 1).
         A checkpoint zip's optional normalizer section is picked up
         automatically (an explicit ``normalizer`` wins) so /predict
-        applies the exact statistics the model trained under.
+        applies the exact statistics the model trained under. The
+        optional quant.json section engages the calibrated int8 path the
+        same way (ISSUE 15): under DL4J_TPU_QUANT the model is wrapped in
+        an ops/lowprec.QuantizedNet and the accuracy delta vs the f32
+        record is MEASURED on the spec's gate sample — a delta past
+        DL4J_TPU_QUANT_MAX_DELTA raises inside this try block, so the
+        record lands BROKEN through the same isolation as any failed
+        restore and the serving default never moves.
 
         A restore that RAISES is isolated, not propagated bare: the
         version lands as a BROKEN record (error preserved, model None)
@@ -158,6 +173,7 @@ class ModelRegistry:
         if model is None and model_path is None:
             raise ValueError("need model or model_path")
         self._check_sealed()
+        quant_info = None
         try:
             if self.chaos is not None:
                 self.chaos.on_load(name)
@@ -173,6 +189,11 @@ class ModelRegistry:
                 )
 
                 normalizer = read_normalizer(model_path)
+            if quant is None and model_path is not None:
+                from deeplearning4j_tpu.utils.serialization import read_quant
+
+                quant = read_quant(model_path)
+            model, quant_info = _maybe_quantize(model, quant)
         except Exception as e:
             self._record_broken(name, e, input_shape=input_shape,
                                 path=model_path)
@@ -185,6 +206,10 @@ class ModelRegistry:
             rec = ModelRecord(name, version, model,
                               input_shape=input_shape, path=model_path,
                               normalizer=normalizer)
+            from deeplearning4j_tpu.ops import lowprec
+
+            rec.precision = lowprec.precision_of(model)
+            rec.quant = quant_info
             versions[version] = rec
             # NOT auto-promoted to the traffic default: only serve()
             # switches traffic (the documented load -> warmup -> serve
@@ -380,6 +405,60 @@ class ModelRegistry:
             recs = [r for vs in self._records.values() for r in vs.values()]
         return [r.describe() for r in
                 sorted(recs, key=lambda r: (r.name, r.version))]
+
+
+def _maybe_quantize(model, spec):
+    """Apply the calibrated int8 serving path (ops/lowprec.QuantizedNet)
+    under the DL4J_TPU_QUANT policy and render the accuracy gate:
+
+    * mode 'off', no spec, or a model without a layer stack → f32 as-is;
+    * 'auto' (default): quantize only when the spec carries a gate sample
+      AND the measured int8-vs-f32 max-abs output delta stays within
+      DL4J_TPU_QUANT_MAX_DELTA — past the bar raises QuantGateError (the
+      caller's try lands the record BROKEN; fail-safe by construction). A
+      sample-less spec serves f32 with verdict 'ungated' rather than
+      serving unproven int8 or breaking a perfectly good f32 record;
+    * 'force': quantize even past the bar — delta still measured and
+      reported, so the override is auditable, never silent.
+
+    Returns (model_or_qnet, quant_info_dict_or_None)."""
+    from deeplearning4j_tpu.ops import lowprec
+
+    mode = lowprec.quant_mode()
+    if spec is None or mode == "off" or not hasattr(model, "layers"):
+        return model, None
+    qnet = lowprec.QuantizedNet(model, spec)
+    layers = qnet.quantized_layers()
+    if not layers:
+        return model, None
+    info: Dict[str, Any] = {
+        "mode": mode,
+        "layers": layers,
+        "max_delta": lowprec.quant_max_delta(),
+    }
+    sample = getattr(spec, "sample", None)
+    if sample is None or getattr(sample, "size", 0) == 0:
+        if mode != "force":
+            info["verdict"] = "ungated"
+            info["delta"] = None
+            return model, info
+        info["verdict"] = "forced-ungated"
+        info["delta"] = None
+        return qnet, info
+    f32_out = np.asarray(model.output(sample))
+    int8_out = np.asarray(qnet.output(sample))
+    delta = float(np.max(np.abs(f32_out - int8_out)))
+    info["delta"] = delta
+    if delta <= info["max_delta"]:
+        info["verdict"] = "ok"
+        return qnet, info
+    if mode == "force":
+        info["verdict"] = "forced"
+        return qnet, info
+    raise lowprec.QuantGateError(
+        f"int8 accuracy gate failed: measured delta {delta:.6g} > "
+        f"DL4J_TPU_QUANT_MAX_DELTA {info['max_delta']:.6g} on the "
+        f"{sample.shape[0]}-row calibration gate sample")
 
 
 def _delete_device_buffers(model) -> None:

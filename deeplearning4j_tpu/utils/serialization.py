@@ -45,6 +45,7 @@ FORMAT_VERSION = 1
 
 TRAINING_STATE_ENTRY = "training_state.json"
 NORMALIZER_ENTRY = "normalizer.json"
+QUANT_ENTRY = "quant.json"
 
 
 def _jsonable_training_state(ts: Dict[str, Any]) -> Dict[str, Any]:
@@ -67,6 +68,7 @@ def write_model_parts(
     meta: dict = None,
     training_state: dict = None,
     normalizer=None,
+    quant=None,
     compression: int = zipfile.ZIP_DEFLATED,
 ) -> None:
     """The single zip writer every checkpoint path shares. ``write_model``
@@ -91,6 +93,8 @@ def write_model_parts(
                        json.dumps(_jsonable_training_state(training_state)))
         if normalizer is not None:
             z.writestr(NORMALIZER_ENTRY, normalizer.to_json())
+        if quant is not None:
+            z.writestr(QUANT_ENTRY, quant.to_json())
         z.writestr("metadata.json", json.dumps(meta))
 
 
@@ -121,6 +125,25 @@ def read_normalizer(path: str):
     from deeplearning4j_tpu.etl.normalize import normalizer_from_json
 
     return normalizer_from_json(payload)
+
+
+def read_quant(path: str):
+    """The optional calibrated-quantization section of a checkpoint zip
+    (etl/calibrate.QuantSpec — per-layer int8 activation scales + the
+    load-time gate sample), or None when absent; rides beside
+    normalizer.json with identical tolerance for pre-quant zips and the
+    orbax directory format. ``ModelRegistry.load`` is the consumer."""
+    import os
+
+    if os.path.isdir(path) or not zipfile.is_zipfile(path):
+        return None
+    with zipfile.ZipFile(path, "r") as z:
+        if QUANT_ENTRY not in z.namelist():
+            return None
+        payload = z.read(QUANT_ENTRY).decode()
+    from deeplearning4j_tpu.etl.calibrate import quant_spec_from_json
+
+    return quant_spec_from_json(payload)
 
 
 def _tree_to_npz_bytes(tree) -> bytes:
@@ -208,14 +231,18 @@ class ModelSerializer:
 
     @staticmethod
     def write_model(net, path: str, save_updater: bool = True,
-                    training_state: dict = None, normalizer=None) -> None:
+                    training_state: dict = None, normalizer=None,
+                    quant=None) -> None:
         """`training_state` (optional): the exact-resume section — pass
         ``net.training_state()`` (possibly extended with epoch/iterator
         cursor) to make the zip resumable without drift; omitted, the zip
         is the original reference-shaped three-part checkpoint.
         `normalizer` (optional): the fitted DataNormalization the model
         was trained under — serving/resume read it back via
-        ``read_normalizer`` so inference applies the SAME statistics."""
+        ``read_normalizer`` so inference applies the SAME statistics.
+        `quant` (optional): a fitted etl/calibrate.QuantSpec — serialized
+        as quant.json so ``ModelRegistry.load`` picks up the calibrated
+        int8 serving path (and its accuracy gate) automatically."""
         write_model_parts(
             path,
             model_class=type(net).__name__,
@@ -226,6 +253,7 @@ class ModelSerializer:
             meta=ModelSerializer._container_meta(net),
             training_state=training_state,
             normalizer=normalizer,
+            quant=quant,
         )
 
     @staticmethod

@@ -35,7 +35,7 @@ EXPECTED = [
     "serving_resilience", "serving_decode", "serving_fleet",
     "checkpoint_overhead",
     "input_pipeline",
-    "elastic_dp", "online_loop", "obs_overhead", "paged_kernel",
+    "elastic_dp", "online_loop", "lowprec", "obs_overhead", "paged_kernel",
     "sgns_kernel",
     "reference_cpu_lenet5_torch", "lenet5_cpu",
     "char_rnn_cpu", "native_feed", "scaling_virtual8",

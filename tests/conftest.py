@@ -109,6 +109,11 @@ _QUICK_FILES = {
     # deterministic drift veto, mirror byte-invisibility — tiny nets,
     # ~15s
     "test_online.py",
+    # low-precision plane (ISSUE 15): int8 value/gate fail-safe contracts,
+    # bf16 loss-scaling (chaos-forced halving, kill/resume bit-exactness,
+    # flagship opt-tree scale state), bf16 KV arena sizing — tiny nets,
+    # ~40s
+    "test_lowprec.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
