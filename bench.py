@@ -1624,6 +1624,8 @@ streams, n_new = int(sys.argv[1]), int(sys.argv[2])
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.models.transformer import (TransformerConfig,
                                                    TransformerLM)
+from deeplearning4j_tpu.ops import env as envknob
+from deeplearning4j_tpu.ops import lowprec
 from deeplearning4j_tpu.serving.decode import ContinuousDecoder
 from deeplearning4j_tpu.serving.engine import ServingEngine
 from deeplearning4j_tpu.serving.paged import PagedDecoder, attention_path
@@ -1730,6 +1732,8 @@ print(json.dumps({
                         else None),
     "preemptions": snap_p["preemptions"],
     "attention_path": attention_path(cfg, BLOCK),
+    "tick_k": envknob.get_int("DL4J_TPU_SERVE_TICK_K", 1),
+    "spec": lowprec.spec_mode() or None,
     "byte_identical": True,
     "span_evidence": {"serve_request": len(reqs),
                       "serve_batch_paged": len(batches)},
@@ -1755,6 +1759,133 @@ def bench_serving_decode(streams=16, n_new=24):
     design — the win is scheduling, not arithmetic."""
     parsed, err = _run_subprocess_json(
         [sys.executable, "-c", _SERVING_DECODE_SCRIPT, str(streams),
+         str(n_new)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
+# decode_amortize: multi-token ticks + self-speculative decoding (ISSUE 16
+# — serving/speculate.py). CPU-only by design: the claim provable off-chip
+# is DISPATCH-COUNT reduction at byte-identical transcripts (the ~5ms
+# fixed per-dispatch overhead this amortizes is a chip number —
+# BENCH_NOTES; the CPU tokens/s rows are honest CPU arithmetic, and the
+# chip single-stream tokens/s row lands at tunnel contact, never faked).
+# ---------------------------------------------------------------------------
+
+_DECODE_AMORTIZE_SCRIPT = r"""
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+k, n_new = int(sys.argv[1]), int(sys.argv[2])
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+from deeplearning4j_tpu.ops import lowprec
+from deeplearning4j_tpu.serving.paged import PagedDecoder
+from deeplearning4j_tpu.serving.speculate import SpeculativeDecoder
+
+BLOCK, STREAMS = 8, 4
+cfg = TransformerConfig(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+                        d_ff=128, max_len=128, use_flash=False)
+lm = TransformerLM(cfg)
+n_blocks = STREAMS * cfg.max_len // BLOCK
+rng = np.random.default_rng(0)
+prompts = [rng.integers(1, 64, 12).astype(np.int32) for _ in range(STREAMS)]
+draft = lowprec.draft_lm(lm, "int8")
+
+
+def run(make):
+    # warm pass on a throwaway decoder compiles every program (the jit
+    # caches are module-level), then a fresh decoder for the timed pass
+    # so tick counters cover exactly the measured work
+    for timed in (False, True):
+        d = make()
+        try:
+            t0 = time.perf_counter()
+            futs = [d.submit(p, n_new, temperature=0.0, timeout_s=600)
+                    for p in prompts]
+            outs = [np.asarray(f.result(timeout=600)).tolist()
+                    for f in futs]
+            wall = time.perf_counter() - t0
+            # single-stream pass: the latency shape the dispatch
+            # amortization actually targets
+            t0 = time.perf_counter()
+            solo = np.asarray(d.submit(prompts[0], n_new, temperature=0.0,
+                                       timeout_s=600).result(timeout=600))
+            solo_wall = time.perf_counter() - t0
+            if timed:
+                ds = d.dispatch_stats.snapshot()
+                return outs, solo.tolist(), {
+                    "wall_s": round(wall, 3),
+                    "tokens_per_sec": round(STREAMS * n_new / wall, 1),
+                    "solo_tokens_per_sec": round(n_new / solo_wall, 1),
+                    "decode_ticks": ds["decode_ticks"],
+                    "decode_tokens": ds["decode_tokens"],
+                    "tokens_per_dispatch": ds["tokens_per_dispatch"],
+                }, d.stats.snapshot()
+        finally:
+            d.stop()
+
+
+base_o, base_solo, base_row, _ = run(lambda: PagedDecoder(
+    lm, block_tokens=BLOCK, n_blocks=n_blocks, tick_k=1))
+tick_o, tick_solo, tick_row, _ = run(lambda: PagedDecoder(
+    lm, block_tokens=BLOCK, n_blocks=n_blocks, tick_k=k))
+spec_o, spec_solo, spec_row, spec_snap = run(lambda: SpeculativeDecoder(
+    lm, draft=draft, spec_k=k, block_tokens=BLOCK, n_blocks=n_blocks))
+
+# equal transcripts are the contract the dispatch reduction rides on
+assert tick_o == base_o and tick_solo == base_solo
+assert spec_o == base_o and spec_solo == base_solo
+
+tick_ratio = round(base_row["decode_ticks"]
+                   / max(1, tick_row["decode_ticks"]), 2)
+spec_ratio = round(base_row["decode_ticks"]
+                   / max(1, spec_row["decode_ticks"]), 2)
+
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "streams": STREAMS,
+    "n_new": n_new,
+    "tick_k": k,
+    "spec_k": k,
+    "draft": "int8",
+    "k1": base_row,
+    "tick": tick_row,
+    "spec": spec_row,
+    "tick_dispatch_ratio": tick_ratio,
+    "tick_dispatch_ratio_bar": round(k / 2, 2),
+    "spec_dispatch_ratio": spec_ratio,
+    "acceptance_rate": spec_snap.get("acceptance_rate"),
+    "byte_identical": True,
+    "stat": "one timed pass per decoder (greedy, pooled then "
+            "single-stream) after a warm pass; tick counters from the "
+            "decoder's own dispatch ledger",
+    "note": "CPU proof is the dispatch-count reduction at equal "
+            "transcripts; per-dispatch overhead here is XLA:CPU's, so "
+            "tokens/s gains are muted — the ~5ms-amortization chip row "
+            "lands at tunnel contact (spec counts draft+verify as 2 "
+            "dispatches, honest about the draft's cost)",
+}))
+"""
+
+
+def bench_decode_amortize(k=4, n_new=24):
+    """Multi-token tick + self-speculative decode leg
+    (serving/speculate.py): dispatch-count reduction of the k-scanned
+    paged tick and the int8 draft-verify round vs k=1 ticking, at
+    byte-identical greedy transcripts (pooled AND single-stream), plus
+    honest CPU tokens/s and the acceptance-rate ledger. Subprocess-
+    isolated, CPU-only by design — the amortized ~5ms dispatch overhead
+    is a chip number; the reduction ratio is backend-invariant."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _DECODE_AMORTIZE_SCRIPT, str(k),
          str(n_new)], 900)
     if parsed is None:
         return {"error": err}
@@ -3158,7 +3289,7 @@ def _run_isolated(name: str, quick: bool, timeout_s: int = 0,
 _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "native_feed", "dispatch_overhead", "serving_throughput",
                   "serving_resilience", "serving_decode", "serving_fleet",
-                  "checkpoint_overhead",
+                  "decode_amortize", "checkpoint_overhead",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
                   "obs_overhead", "paged_kernel", "sgns_kernel",
@@ -3359,7 +3490,8 @@ def main():
             elif name in ("scaling_virtual8", "north_star", "lstm_kernel",
                           "dispatch_overhead", "serving_throughput",
                           "serving_resilience", "serving_decode",
-                          "serving_fleet", "checkpoint_overhead",
+                          "serving_fleet", "decode_amortize",
+                          "checkpoint_overhead",
                           "lenet5_cpu", "char_rnn_cpu", "remat_memory",
                           "input_pipeline", "elastic_dp", "obs_overhead",
                           "paged_kernel", "sgns_kernel"):
@@ -3424,6 +3556,8 @@ def main():
         per_client=4 if quick else 16)
     run("serving_decode", bench_serving_decode,
         streams=16, n_new=12 if quick else 24)
+    run("decode_amortize", bench_decode_amortize,
+        k=4, n_new=12 if quick else 24)
     run("serving_resilience", bench_serving_resilience,
         per_client=4 if quick else 8)
     run("serving_fleet", bench_serving_fleet,

@@ -183,6 +183,15 @@ class DispatchStats:
                      points (training_state() / net.loss_scale), never
                      per step — reading it per step would be a hidden
                      device sync.
+      decode_ticks / decode_tokens
+                     continuous-decode dispatch amortization (ISSUE 16:
+                     serving/decode.py + serving/paged.py multi-token
+                     ticks): jitted decode dispatches and the tokens
+                     they produced, summed over every lane. The derived
+                     ``tokens_per_dispatch`` in snapshot() is the number
+                     the ~5ms-per-dispatch overhead divides by — 1.0 is
+                     the single-token baseline, k*lanes the scanned
+                     ceiling.
     """
 
     def __init__(self) -> None:
@@ -195,6 +204,8 @@ class DispatchStats:
         self.padded_examples = 0
         self.fused_fallbacks = 0
         self.loss_scale_skips = 0
+        self.decode_ticks = 0
+        self.decode_tokens = 0
 
     def cache_hits(self, name: Optional[str] = None) -> int:
         if name is not None:
@@ -214,6 +225,11 @@ class DispatchStats:
             "padded_examples": self.padded_examples,
             "fused_fallbacks": self.fused_fallbacks,
             "loss_scale_skips": self.loss_scale_skips,
+            "decode_ticks": self.decode_ticks,
+            "decode_tokens": self.decode_tokens,
+            "tokens_per_dispatch": (
+                round(self.decode_tokens / self.decode_ticks, 4)
+                if self.decode_ticks else None),
         }
 
 

@@ -196,6 +196,21 @@ _register("DL4J_TPU_SERVE_SLO_CLASSES", "", "str",
           "SLO scheduling classes 'name:deadline_s,...' highest "
           "priority first ('' = one default class at the request "
           "timeout)")
+_register("DL4J_TPU_SERVE_TICK_K", "1", "int",
+          "decode tokens per jitted tick (lax.scan inside one dispatch) "
+          "for the fixed-slot and paged /generate pools; the worker "
+          "adaptively drops to 1 whenever admissions are pending or any "
+          "lane is within k tokens of its budget, so scheduling "
+          "semantics are per-token while steady-state decode pays the "
+          "~5ms dispatch overhead once per k tokens")
+_register("DL4J_TPU_SERVE_SPEC", "", "str",
+          "self-speculative decoding draft for greedy /generate on the "
+          "paged pool: '' off, int8 = weight-quantized self-draft, "
+          "layers[:m] = truncated-layer self-draft (m = draft depth, "
+          "default half the target's layers)")
+_register("DL4J_TPU_SERVE_SPEC_K", "4", "int",
+          "draft tokens proposed per speculative round (the target "
+          "verifies k+1 positions in one dispatch)")
 _register("DL4J_TPU_SERVE_FLEET_REPLICAS", "2", "int",
           "serving-fleet replica count (ServingFleet default)")
 _register("DL4J_TPU_SERVE_ROUTER_PORT", "0", "int",

@@ -47,6 +47,7 @@ __all__ = [
     "opt_scale_entries", "opt_scale_state", "opt_with_scale",
     "quant_mode", "quant_max_delta", "quantize_weight", "int8_dense",
     "QuantizedNet", "QuantGateError", "kv_dtype", "precision_of",
+    "spec_mode", "draft_lm",
 ]
 
 # ---------------------------------------------------------------------------
@@ -373,6 +374,94 @@ class QuantizedNet:
             return fn(self.params, self.states,
                       dispatch.pad_axis0(x, target))[:n]
         return fn(self.params, self.states, x)
+
+
+# ---------------------------------------------------------------------------
+# self-speculative drafts (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def spec_mode() -> str:
+    """Draft selector for self-speculative decoding from
+    DL4J_TPU_SERVE_SPEC: '' = off, 'int8' = weight-quantized self-draft,
+    'layers' / 'layers:m' = truncated-layer self-draft."""
+    v = (env.get_str("DL4J_TPU_SERVE_SPEC") or "").strip().lower()
+    if v in ("", "0", "off", "false", "no"):
+        return ""
+    if v in ("1", "on", "true", "yes"):
+        return "int8"  # bare enable = the default self-draft
+    return v
+
+
+# the per-block weight matrices the int8 self-draft fake-quantizes; LN
+# gains/biases and the embedding table stay f32 (the embedding doubles
+# as the output head — quantizing it would move the head, not a matmul)
+_DRAFT_WEIGHT_KEYS = ("Wq", "Wk", "Wv", "Wo", "W1", "W2")
+
+
+def _fake_quant_matrix(w):
+    """quantize-then-dequantize one [in, out] matrix: the draft keeps the
+    target's program family (f32 matmuls over int8-rounded VALUES), so
+    on CPU the win is dispatch counts, and the chip's int8 MXU payoff is
+    armed behind the same weights-only scheme QuantizedNet gates."""
+    wq, scale = quantize_weight(w)
+    return (wq.astype(jnp.float32) * scale).astype(jnp.asarray(w).dtype)
+
+
+def draft_lm(lm, mode: str = "int8"):
+    """Build the self-draft TransformerLM a SpeculativeDecoder proposes
+    with (serving/speculate.py; Leviathan et al. 2023 draft-verify).
+
+    Two selectable drafts, both derived from the TARGET's own weights so
+    no second checkpoint is needed ("self-speculative"):
+
+    * ``int8`` — every per-block weight matrix is fake-quantized
+      (per-channel symmetric round-trip through :func:`quantize_weight`,
+      the PR 15 scheme): same depth, same decode programs, int8-rounded
+      weight values. Honest label: weight-only quantization at f32
+      compute — acceptance-rate is what the draft is judged by, and the
+      verify step makes ANY draft error harmless.
+    * ``layers`` / ``layers:m`` — the first m transformer blocks (default
+      half, min 1) under the target's final LN + embedding head: a
+      genuinely cheaper program (m/L of the FLOPs and dispatch depth).
+
+    The draft shares the target's embedding/LN buffers (read-only) and
+    carries no optimizer state. Mesh-sharded targets are rejected — the
+    decode planes are single-device (serving/decode.py module note)."""
+    import dataclasses
+
+    from deeplearning4j_tpu.models.transformer import TransformerLM
+
+    if getattr(lm, "mesh", None) is not None:
+        raise ValueError("speculative drafts need a single-device LM")
+    cfg = lm._run_cfg
+    mode = (mode or "int8").strip().lower()
+    if mode == "int8":
+        blocks = dict(lm.params["blocks"])
+        for k in _DRAFT_WEIGHT_KEYS:
+            if k in blocks:
+                blocks[k] = jax.vmap(_fake_quant_matrix)(blocks[k])
+        params = dict(lm.params)
+        params["blocks"] = blocks
+        dcfg = cfg
+    elif mode.startswith("layers"):
+        _, _, tail = mode.partition(":")
+        m = int(tail) if tail else max(1, cfg.n_layers // 2)
+        if not 1 <= m <= cfg.n_layers:
+            raise ValueError(
+                f"draft depth {m} out of range [1, {cfg.n_layers}]")
+        params = dict(lm.params)
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda a: a[:m], lm.params["blocks"])
+        dcfg = dataclasses.replace(cfg, n_layers=m)
+    else:
+        raise ValueError(
+            f"unknown draft mode {mode!r} (want 'int8' or 'layers[:m]')")
+    draft = TransformerLM.from_state(dcfg, params)
+    # a draft never trains: drop the optimizer zeros from_state allocated
+    draft.opt = None
+    draft.draft_mode = mode
+    return draft
 
 
 # ---------------------------------------------------------------------------

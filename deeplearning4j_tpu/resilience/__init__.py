@@ -23,6 +23,8 @@ from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
     RouterChaosConfig,
     ServingChaos,
     ServingChaosConfig,
+    SpecChaos,
+    SpecChaosConfig,
     TransientDeviceError,
 )
 from deeplearning4j_tpu.resilience.checkpoint import (  # noqa: F401

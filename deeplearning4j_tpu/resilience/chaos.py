@@ -525,6 +525,57 @@ class LowPrecChaos:
         return bad
 
 
+@dataclass
+class SpecChaosConfig:
+    """Declarative all-reject plan for the speculative-decode acceptance
+    contract (serving/speculate.py): corrupt the draft's proposals for
+    round ``reject_at_round`` (1-based) so the target's greedy choice
+    disagrees at every position — the all-reject path must discard the
+    whole draft suffix and still commit the target's own first token,
+    byte-exact vs target-only decoding. Config-driven, never ambient."""
+
+    reject_at_round: Optional[int] = None
+    count: int = 1     # consecutive corrupted rounds from reject_at_round
+
+    def __post_init__(self):
+        if self.count < 1:
+            raise ValueError("count must be >= 1")
+
+
+class SpecChaos:
+    """Stateful executor of a :class:`SpecChaosConfig`. The corruption
+    fires at ACCEPTANCE-COMPARISON time, after the verify dispatch ran on
+    the true proposals: each proposal becomes (target_greedy + 1) % vocab,
+    which can never match, so the round rejects everything deterministically.
+    This is byte-safe by the all-reject commit rule — the only token an
+    all-reject round commits is the target's first correction, which is a
+    function of the last COMMITTED token and no proposal at all."""
+
+    def __init__(self, config: SpecChaosConfig):
+        if isinstance(config, dict):
+            config = SpecChaosConfig(**config)
+        self.config = config
+        self.log: list = []  # (round, fault) audit trail for tests
+
+    def corrupt(self, round_idx: int, proposed, target_greedy,
+                vocab_size: int):
+        """``round_idx`` is the 1-based speculative round about to score
+        acceptance. Returns the proposals to compare (a corrupted COPY on
+        fault rounds — the caller's array is never mutated)."""
+        c = self.config
+        if (c.reject_at_round is None
+                or not (c.reject_at_round <= round_idx
+                        < c.reject_at_round + c.count)):
+            return proposed
+        import numpy as np
+
+        bad = np.array(proposed, dtype=np.int32, copy=True)
+        g = np.asarray(target_greedy, np.int32).reshape(-1)[:bad.size]
+        bad[:] = (g + 1) % int(vocab_size)
+        self.log.append((round_idx, "reject_all"))
+        return bad
+
+
 def truncate_file(path: str, keep: int = 16) -> None:
     """Write-then-truncate fault: keep only the first `keep` bytes (a
     crash mid-write that an atomic rename would normally prevent —

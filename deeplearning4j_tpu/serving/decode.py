@@ -51,7 +51,9 @@ from deeplearning4j_tpu.models.transformer import (
     _ln,
     prefill_cache,
 )
+from deeplearning4j_tpu.obs.registry import register_net
 from deeplearning4j_tpu.ops import dispatch
+from deeplearning4j_tpu.ops import env as envknob
 from deeplearning4j_tpu.serving.batcher import RequestTimeoutError
 from deeplearning4j_tpu.serving.resilience import WorkerDeadError
 from deeplearning4j_tpu.serving.telemetry import ServingStats
@@ -107,28 +109,62 @@ def decode_step_slots(params, cache, tok, pos, cfg: TransformerConfig):
 # jitted decode programs shared across decoder instances: cfg is a frozen
 # (hashable) dataclass, and a per-instance @jax.jit closure would pay a
 # fresh XLA compile every time an engine (re)builds its decoder — exactly
-# the cost class this subsystem exists to amortize
-_TICK_CACHE: Dict[TransformerConfig, object] = {}
+# the cost class this subsystem exists to amortize. k (tokens per tick,
+# ISSUE 16) rides the cache key like a config field: the adaptive worker
+# only ever asks for k=1 and k=tick_k, so at most two programs exist.
+_TICK_CACHE: Dict[tuple, object] = {}
 _ADMIT_CACHE: Dict[tuple, object] = {}
 
 
-def _tick_for(cfg: TransformerConfig):
-    fn = _TICK_CACHE.get(cfg)
+def _sample_step(logits, keys, temps):
+    """Shared per-step sampler: per-slot key split + temperature select.
+    Factored out so the k=1 direct tick and the k>1 scanned tick run the
+    IDENTICAL op sequence — the byte-identity contract between them
+    (tests/test_speculate.py) rests on this body being shared."""
+    split = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
+    nkeys, subs = split[:, 0], split[:, 1]
+    tempered = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(subs, tempered)
+    greedy = jnp.argmax(logits, axis=-1)
+    nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+    return nxt, nkeys
+
+
+def _tick_for(cfg: TransformerConfig, k: int = 1):
+    """k decode steps inside ONE jitted dispatch -> tokens [S, k].
+
+    k=1 keeps the original direct body (reshaped to [S, 1] so the host
+    unpack is uniform); k>1 wraps the same body in lax.scan carrying
+    (cache, tok, pos, keys) — one dispatch amortizes the ~5ms fixed
+    overhead (BENCH_NOTES) over k tokens. Scheduling stays per-token:
+    the WORKER decides k each iteration (adaptive drop to 1), the
+    program just executes it."""
+    key = (cfg, int(k))
+    fn = _TICK_CACHE.get(key)
     if fn is not None:
         return fn
 
-    @jax.jit
-    def tick(params, cache, tok, pos, keys, temps):
-        cache, logits = decode_step_slots(params, cache, tok, pos, cfg)
-        split = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
-        nkeys, subs = split[:, 0], split[:, 1]
-        tempered = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.vmap(jax.random.categorical)(subs, tempered)
-        greedy = jnp.argmax(logits, axis=-1)
-        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-        return cache, nxt, nkeys
+    if k == 1:
+        @jax.jit
+        def tick(params, cache, tok, pos, keys, temps):
+            cache, logits = decode_step_slots(params, cache, tok, pos, cfg)
+            nxt, nkeys = _sample_step(logits, keys, temps)
+            return cache, nxt[:, None], nkeys
+    else:
+        @jax.jit
+        def tick(params, cache, tok, pos, keys, temps):
+            def step(carry, _):
+                cache, tok, pos, keys = carry
+                cache, logits = decode_step_slots(params, cache, tok, pos,
+                                                  cfg)
+                nxt, keys = _sample_step(logits, keys, temps)
+                return (cache, nxt, pos + 1, keys), nxt
 
-    _TICK_CACHE[cfg] = tick
+            (cache, _, _, keys), toks = lax.scan(
+                step, (cache, tok, pos, keys), None, length=k)
+            return cache, jnp.swapaxes(toks, 0, 1), keys
+
+    _TICK_CACHE[key] = tick
     return tick
 
 
@@ -192,7 +228,7 @@ class ContinuousDecoder:
     def __init__(self, lm, slots: int = 4,
                  stats: Optional[ServingStats] = None,
                  default_timeout_s: float = 300.0,
-                 chaos=None) -> None:
+                 chaos=None, tick_k: Optional[int] = None) -> None:
         cfg = lm._run_cfg
         if lm.mesh is not None:
             raise ValueError("continuous decode needs a single-device LM "
@@ -230,7 +266,18 @@ class ContinuousDecoder:
         self._chaos = chaos
         self._dead: Optional[str] = None
         self.peak_active = 0  # high-water concurrent sequences (bench)
-        self._tick = _tick_for(cfg)
+        # multi-token ticks (ISSUE 16): steady-state decode scans tick_k
+        # steps per dispatch; the worker adaptively drops to k=1 whenever
+        # admissions are pending or any lane is within k tokens of its
+        # budget, so scheduling semantics stay per-token
+        self.tick_k = max(1, int(
+            tick_k if tick_k is not None
+            else envknob.get_int("DL4J_TPU_SERVE_TICK_K", 1)))
+        # decoder-owned dispatch ledger (TransformerLM carries only
+        # memory_stats): decode_ticks / decode_tokens make the
+        # amortization win visible at /metrics beside serving_stats
+        self.dispatch_stats = dispatch.DispatchStats()
+        register_net(self)
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="continuous-decoder")
         self._worker.start()
@@ -428,6 +475,24 @@ class ContinuousDecoder:
                         return
                     self._cond.wait()
                     continue
+                # adaptive k (ISSUE 16): a literal drop to 1 — never an
+                # intermediate clamp — so only the k=1 and k=tick_k
+                # programs ever compile. Pending admissions must not wait
+                # out a long tick, and a lane within k tokens of its
+                # budget (or of max_len) must finish at its exact
+                # boundary, token-for-token identical to k=1 scheduling.
+                k = self.tick_k
+                if k > 1:
+                    if self._pending:
+                        k = 1
+                    else:
+                        for i in active:
+                            st = self._slots[i]
+                            if (st.remaining < k
+                                    or int(self._pos[i]) + k
+                                    > self.cfg.max_len - 1):
+                                k = 1
+                                break
             for i, buf, width in admits:
                 try:
                     if self._chaos is not None:
@@ -448,9 +513,10 @@ class ContinuousDecoder:
                     active = [j for j in active if j != i]
             if not active:
                 continue
-            # one fixed-shape device tick for the whole pool (no lock held)
+            # one fixed-shape device tick for the whole pool (no lock
+            # held): k scanned steps per dispatch, tokens [S, k]
             try:
-                self._cache, nxt, keys = self._tick(
+                self._cache, nxt, keys = _tick_for(self.cfg, k)(
                     self.lm.params, self._cache, jnp.asarray(self._tok),
                     jnp.asarray(self._pos), jnp.asarray(self._keys),
                     jnp.asarray(self._temps))
@@ -459,21 +525,30 @@ class ContinuousDecoder:
                 self._fail_active_slots(e)
                 continue
             self._keys = np.array(keys)  # writable copy (slot admits write)
+            self.dispatch_stats.decode_ticks += 1
+            self.dispatch_stats.decode_tokens += len(active) * k
             with self._cond:
                 for i in active:
                     st = self._slots[i]
-                    st.tokens.append(int(nxt[i]))
-                    self._tok[i] = nxt[i]
-                    self._pos[i] += 1
-                    st.remaining -= 1
-                    self.stats.record_tokens(1)
-                    done = (st.remaining <= 0
-                            or self._pos[i] >= self.cfg.max_len - 1)
-                    if done:
-                        if not st.future.done():
-                            st.future.set_result(
-                                np.asarray(st.tokens, np.int32))
-                            self.stats.record_latency(
-                                time.monotonic() - st.enqueued)
-                        self._slots[i] = None  # evict; slot is free
+                    # host-side unpack of the k-vector: per-token
+                    # bookkeeping fires k times, so eviction lands at the
+                    # exact token boundary it would under k=1 (the
+                    # adaptive rule guarantees no lane finishes mid-tick,
+                    # but the break keeps the invariant local)
+                    for j in range(k):
+                        st.tokens.append(int(nxt[i, j]))
+                        self._tok[i] = nxt[i, j]
+                        self._pos[i] += 1
+                        st.remaining -= 1
+                        self.stats.record_tokens(1)
+                        done = (st.remaining <= 0
+                                or self._pos[i] >= self.cfg.max_len - 1)
+                        if done:
+                            if not st.future.done():
+                                st.future.set_result(
+                                    np.asarray(st.tokens, np.int32))
+                                self.stats.record_latency(
+                                    time.monotonic() - st.enqueued)
+                            self._slots[i] = None  # evict; slot is free
+                            break
                 self._cond.notify_all()  # drain() waiters see evictions

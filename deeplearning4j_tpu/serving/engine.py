@@ -558,12 +558,13 @@ class ServingEngine:
                     return None
                 try:
                     if self.kv_block > 0:
+                        from deeplearning4j_tpu.ops import lowprec
                         from deeplearning4j_tpu.serving.paged import (
                             PagedDecoder,
                         )
 
-                        decoder = PagedDecoder(
-                            rec.model, block_tokens=self.kv_block,
+                        paged_kw = dict(
+                            block_tokens=self.kv_block,
                             n_blocks=self.kv_blocks or None,
                             min_lanes=self.slots, stats=self.stats,
                             default_timeout_s=max(self.request_timeout_s,
@@ -571,6 +572,21 @@ class ServingEngine:
                             chaos=self.chaos,
                             slo_classes=self.slo_classes or None,
                             queue_cap=self.queue_capacity)
+                        spec = lowprec.spec_mode()
+                        if spec:
+                            # DL4J_TPU_SERVE_SPEC: the paged pool gains
+                            # a draft-verify round (serving/speculate);
+                            # a ValueError (mesh, vocab, MoE, draft
+                            # derivation) falls through to _no_decoder
+                            # like any eligibility failure
+                            from deeplearning4j_tpu.serving.speculate \
+                                import SpeculativeDecoder
+
+                            decoder = SpeculativeDecoder(
+                                rec.model, draft=rec.draft_net(spec),
+                                **paged_kw)
+                        else:
+                            decoder = PagedDecoder(rec.model, **paged_kw)
                     else:
                         from deeplearning4j_tpu.serving.decode import (
                             ContinuousDecoder,

@@ -79,7 +79,9 @@ from deeplearning4j_tpu.models.transformer import (
     prefill_cache,
 )
 from deeplearning4j_tpu.obs import trace as obs_trace
+from deeplearning4j_tpu.obs.registry import register_net
 from deeplearning4j_tpu.ops import dispatch
+from deeplearning4j_tpu.ops import env as envknob
 from deeplearning4j_tpu.ops import lowprec
 from deeplearning4j_tpu.ops import memory as opsmem
 from deeplearning4j_tpu.ops import pallas_paged
@@ -87,6 +89,7 @@ from deeplearning4j_tpu.serving.batcher import (
     QueueFullError,
     RequestTimeoutError,
 )
+from deeplearning4j_tpu.serving.decode import _sample_step
 from deeplearning4j_tpu.serving.resilience import (
     ClientRequestError,
     WorkerDeadError,
@@ -191,27 +194,42 @@ _PAGED_TICK_CACHE: Dict[tuple, object] = {}
 _PAGED_ADMIT_CACHE: Dict[tuple, object] = {}
 
 
-def _paged_tick_for(cfg: TransformerConfig, block_tokens: int):
+def _paged_tick_for(cfg: TransformerConfig, block_tokens: int, k: int = 1):
     # the attention path (and its interpret flag) is resolved HERE, not
     # inside the trace: a knob flip after the first tick must rebuild the
-    # jitted program, so the resolved path rides the cache key
+    # jitted program, so the resolved path rides the cache key. k (tokens
+    # per tick, ISSUE 16) rides it the same way: the adaptive worker only
+    # ever asks for k=1 and k=tick_k, so at most two programs per path.
     path = attention_path(cfg, block_tokens)
     key = (cfg, block_tokens, path,
-           path == "kernel" and pallas_paged.paged_interpret())
+           path == "kernel" and pallas_paged.paged_interpret(), int(k))
     fn = _PAGED_TICK_CACHE.get(key)
     if fn is not None:
         return fn
 
-    def tick(params, arena, tok, pos, tables, keys, temps):
-        arena, logits = paged_decode_step(params, arena, tok, pos, tables,
-                                          cfg, attention=path)
-        split = jax.vmap(jax.random.split)(keys)   # [S, 2, 2]
-        nkeys, subs = split[:, 0], split[:, 1]
-        tempered = logits / jnp.maximum(temps, 1e-6)[:, None]
-        sampled = jax.vmap(jax.random.categorical)(subs, tempered)
-        greedy = jnp.argmax(logits, axis=-1)
-        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-        return arena, nxt, nkeys
+    if k == 1:
+        def tick(params, arena, tok, pos, tables, keys, temps):
+            arena, logits = paged_decode_step(params, arena, tok, pos,
+                                              tables, cfg, attention=path)
+            nxt, nkeys = _sample_step(logits, keys, temps)
+            return arena, nxt[:, None], nkeys
+    else:
+        # k scanned steps in ONE dispatch: the per-step body (scatter at
+        # pos, gather/attend, sample) is IDENTICAL to the k=1 tick, so
+        # transcripts are byte-equal to k single ticks; the block tables
+        # are loop constants — the worker pre-grew every lane's table k
+        # positions ahead (_grow lookahead)
+        def tick(params, arena, tok, pos, tables, keys, temps):
+            def step(carry, _):
+                arena, tok, pos, keys = carry
+                arena, logits = paged_decode_step(
+                    params, arena, tok, pos, tables, cfg, attention=path)
+                nxt, keys = _sample_step(logits, keys, temps)
+                return (arena, nxt, pos + 1, keys), nxt
+
+            (arena, _, _, keys), toks = lax.scan(
+                step, (arena, tok, pos, keys), None, length=k)
+            return arena, jnp.swapaxes(toks, 0, 1), keys
 
     # the arena is single-owner (the worker rebinds every tick), so it
     # donates even on CPU — an un-donated tick would memcpy the whole
@@ -410,7 +428,8 @@ class PagedDecoder:
                  default_timeout_s: float = 300.0,
                  chaos=None,
                  slo_classes: Optional[List[SLOClass]] = None,
-                 queue_cap: Optional[int] = None) -> None:
+                 queue_cap: Optional[int] = None,
+                 tick_k: Optional[int] = None) -> None:
         cfg = lm._run_cfg
         if lm.mesh is not None:
             raise ValueError("paged decode needs a single-device LM "
@@ -472,7 +491,35 @@ class PagedDecoder:
         self._seq = 0        # submit/requeue order (shed picks youngest)
         self._admit_seq = 0  # admission order (preemption picks youngest)
         self.peak_active = 0
-        self._tick = _paged_tick_for(cfg, bt)
+        # multi-token ticks (ISSUE 16): steady-state decode scans tick_k
+        # steps per dispatch, adaptively dropping to 1 whenever
+        # admissions are pending or any lane is within k tokens of its
+        # budget — scheduling semantics stay per-token
+        self.tick_k = max(1, int(
+            tick_k if tick_k is not None
+            else envknob.get_int("DL4J_TPU_SERVE_TICK_K", 1)))
+        # decoder-owned dispatch ledger (TransformerLM carries only
+        # memory_stats): decode_ticks / decode_tokens surface the
+        # amortization win at /metrics
+        self.dispatch_stats = dispatch.DispatchStats()
+        register_net(self)
+        # per-k tick memo: the attention path is resolved ONCE per k at
+        # first use (construction-time for k=1, matching the old
+        # self._tick behavior) — not per iteration, where the kernel
+        # gate's measured-win lookup would run per generated token
+        self._ticks: Dict[int, object] = {1: _paged_tick_for(cfg, bt)}
+        self._start_worker()
+
+    def _tick_fn(self, k: int):
+        fn = self._ticks.get(k)
+        if fn is None:
+            fn = _paged_tick_for(self.cfg, self.block_tokens, k)
+            self._ticks[k] = fn
+        return fn
+
+    def _start_worker(self) -> None:
+        """Factored out so subclasses (serving/speculate.py) can finish
+        their own state setup before the decode thread goes live."""
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name="paged-decoder")
         self._worker.start()
@@ -675,12 +722,15 @@ class PagedDecoder:
         self.stats.record_preemption()
         self.stats.set_queue_depth(self._total_pending(), "decode")
 
-    def _grow(self, i: int) -> bool:
-        """Ensure lane i's next write block is allocated; preempts the
-        youngest admission (possibly lane i itself) on exhaustion.
-        Returns False iff lane i was preempted."""
+    def _grow(self, i: int, lookahead: int = 0) -> bool:
+        """Ensure lane i's write blocks through position pos+lookahead
+        are allocated (a k-token tick writes positions pos..pos+k-1, so
+        the worker grows with lookahead=k-1); preempts the youngest
+        admission (possibly lane i itself) on exhaustion. Returns False
+        iff lane i was preempted."""
         lane = self._slots[i]
-        while int(self._pos[i]) // self.block_tokens >= lane.n_table:
+        while (int(self._pos[i]) + lookahead) // self.block_tokens \
+                >= lane.n_table:
             b = self._blocks.alloc()
             if b is None:
                 self._prefix.reclaim(1)
@@ -768,8 +818,11 @@ class PagedDecoder:
         self.stats.set_kv_blocks(self._blocks.in_use, self.n_blocks)
         return buf, width, write_table, inserts
 
-    def _admit_prefill(self, buf: np.ndarray, width: int,
+    def _admit_prefill(self, i: int, buf: np.ndarray, width: int,
                        write_table: np.ndarray) -> None:
+        # the lane index rides the signature so subclasses with per-lane
+        # side state (serving/speculate.py prefills its draft cache row
+        # here) share this crash-isolation boundary
         self._arena = _paged_admit_for(self.cfg, width, self.block_tokens)(
             self.lm.params, self._arena, jnp.asarray(buf),
             jnp.asarray(write_table))
@@ -846,7 +899,7 @@ class PagedDecoder:
                 try:
                     if self._chaos is not None:
                         self._chaos.on_admit()
-                    self._admit_prefill(buf, width, write_table)
+                    self._admit_prefill(i, buf, width, write_table)
                 except Exception as e:  # noqa: BLE001 — lane isolation boundary
                     # a crashed admission evicts ONLY its own lane and
                     # returns its blocks to the free list; the prefill
@@ -875,48 +928,81 @@ class PagedDecoder:
                     with self._cond:
                         for digest, block in inserts:
                             self._prefix.insert(digest, block)
-            with self._cond:
-                self.stats.set_queue_depth(self._total_pending(), "decode")
-                active = [i for i in range(self.lanes)
-                          if self._slots[i] is not None]
-                self.peak_active = max(self.peak_active, len(active))
-                if not active:
-                    if not self._running:
-                        return
-                    self._cond.wait()
-                    continue
-                for i in range(self.lanes):
-                    if self._slots[i] is not None:
-                        self._grow(i)
-                active = [i for i in range(self.lanes)
-                          if self._slots[i] is not None]
+            if not self._tick_phase():
+                return
+
+    def _tick_phase(self) -> bool:
+        """One scheduling decision + device tick + host unpack (the tail
+        of the worker iteration, factored out so serving/speculate.py can
+        interpose its draft-verify round). Returns False only when the
+        worker should exit (stopped and idle)."""
+        with self._cond:
+            self.stats.set_queue_depth(self._total_pending(), "decode")
+            active = [i for i in range(self.lanes)
+                      if self._slots[i] is not None]
+            self.peak_active = max(self.peak_active, len(active))
             if not active:
-                continue
-            # one fixed-shape device tick for the whole pool (no lock
-            # held); the serve.batch span joins the request spans the
-            # engine opened (PR 7 tracer)
-            try:
-                with obs_trace.span("serve.batch", kind="decode.paged",
-                                    lanes=len(active)):
-                    self._arena, nxt, keys = self._tick(
-                        self.lm.params, self._arena,
-                        jnp.asarray(self._tok), jnp.asarray(self._pos),
-                        jnp.asarray(self._tables),
-                        jnp.asarray(self._keys),
-                        jnp.asarray(self._temps))
-                    nxt = np.asarray(nxt)
-            except Exception as e:  # noqa: BLE001 — device boundary
-                self._fail_active_lanes(e)
-                continue
-            self._keys = np.array(keys)  # writable copy (admits write rows)
-            callbacks = []
-            completions = []
-            with self._cond:
-                for i in active:
-                    st = self._slots[i]
-                    if st is None:
-                        continue
-                    t = int(nxt[i])
+                if not self._running:
+                    return False
+                self._cond.wait()
+                return True
+            # adaptive k (ISSUE 16): a literal drop to 1 — never an
+            # intermediate clamp — so only the k=1 and k=tick_k
+            # programs ever compile. Pending admissions must not
+            # wait out a long tick, and a lane within k tokens of
+            # its budget (or of max_len) must finish at the exact
+            # boundary it would under k=1 scheduling.
+            k = self.tick_k
+            if k > 1:
+                if self._total_pending():
+                    k = 1
+                else:
+                    for i in active:
+                        st = self._slots[i]
+                        if (st.remaining < k
+                                or int(self._pos[i]) + k
+                                > self.cfg.max_len - 1):
+                            k = 1
+                            break
+            for i in range(self.lanes):
+                if self._slots[i] is not None:
+                    self._grow(i, lookahead=k - 1)
+            active = [i for i in range(self.lanes)
+                      if self._slots[i] is not None]
+        if not active:
+            return True
+        # one fixed-shape device tick for the whole pool (no lock
+        # held): k scanned steps per dispatch, tokens [S, k]; the
+        # serve.batch span joins the request spans the engine
+        # opened (PR 7 tracer)
+        try:
+            with obs_trace.span("serve.batch", kind="decode.paged",
+                                lanes=len(active), tick_k=k):
+                self._arena, nxt, keys = self._tick_fn(k)(
+                    self.lm.params, self._arena,
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    jnp.asarray(self._tables),
+                    jnp.asarray(self._keys),
+                    jnp.asarray(self._temps))
+                nxt = np.asarray(nxt)
+        except Exception as e:  # noqa: BLE001 — device boundary
+            self._fail_active_lanes(e)
+            return True
+        self._keys = np.array(keys)  # writable copy (admits write rows)
+        self.dispatch_stats.decode_ticks += 1
+        self.dispatch_stats.decode_tokens += len(active) * k
+        callbacks = []
+        completions = []
+        with self._cond:
+            for i in active:
+                st = self._slots[i]
+                if st is None:
+                    continue
+                # host-side unpack of the k-vector: per-token
+                # bookkeeping and streaming callbacks fire k times,
+                # in emission order, exactly as k=1 ticks would
+                for j in range(k):
+                    t = int(nxt[i, j])
                     st.tokens.append(t)
                     self._tok[i] = t
                     self._pos[i] += 1
@@ -928,16 +1014,18 @@ class PagedDecoder:
                             or self._pos[i] >= self.cfg.max_len - 1):
                         completions.append(st)
                         self._release_lane(i)
-                self._cond.notify_all()  # drain() waiters see evictions
-            # stream callbacks BEFORE resolving futures (a client
-            # iterating tokens must see the last token before done), and
-            # outside the lock (a slow client must not stall the pool)
-            for cb, t in callbacks:
-                try:
-                    cb(t)
-                except Exception:  # noqa: BLE001 — client callback boundary
-                    pass
-            for st in completions:
-                if not st.future.done():
-                    st.future.set_result(np.asarray(st.tokens, np.int32))
-                    self.stats.record_latency(time.monotonic() - st.enqueued)
+                        break
+            self._cond.notify_all()  # drain() waiters see evictions
+        # stream callbacks BEFORE resolving futures (a client
+        # iterating tokens must see the last token before done), and
+        # outside the lock (a slow client must not stall the pool)
+        for cb, t in callbacks:
+            try:
+                cb(t)
+            except Exception:  # noqa: BLE001 — client callback boundary
+                pass
+        for st in completions:
+            if not st.future.done():
+                st.future.set_result(np.asarray(st.tokens, np.int32))
+                self.stats.record_latency(time.monotonic() - st.enqueued)
+        return True

@@ -80,10 +80,36 @@ class ModelRecord:
         # the default this record REPLACED when serve() promoted it
         # ("name@vN" or None) — the auditable rollback target (ISSUE 14)
         self.prior_default: Optional[str] = None
+        # self-drafts for speculative decoding (ISSUE 16), cached per
+        # mode: ONE quantization per record however many decoders the
+        # engine (re)builds around it
+        self._drafts: Dict[str, Any] = {}
 
     @property
     def key(self) -> str:
         return f"{self.name}@v{self.version}"
+
+    def draft_net(self, mode: str = "int8"):
+        """The self-draft a SpeculativeDecoder proposes with
+        (serving/speculate.py). An already-int8 record (the PR 15
+        QuantizedNet wrapper) IS its own int8 form — one quantization,
+        one gate verdict; otherwise the draft is derived from this
+        record's weights via ops/lowprec.draft_lm and cached so repeat
+        decoder builds never re-quantize."""
+        mode = (mode or "int8").strip().lower()
+        if self.model is None:
+            raise ValueError(
+                f"record {self.key} has no model (state={self.state})")
+        if mode == "int8" and \
+                getattr(self.model, "precision", None) == "int8":
+            return self.model
+        draft = self._drafts.get(mode)
+        if draft is None:
+            from deeplearning4j_tpu.ops import lowprec
+
+            draft = lowprec.draft_lm(self.model, mode)
+            self._drafts[mode] = draft
+        return draft
 
     def describe(self) -> Dict[str, Any]:
         out = {

@@ -73,6 +73,12 @@ class ServingStats:
         self.prefix_lookups = 0    # prompt blocks consulted in the cache
         self.prefix_hits = 0       # prompt blocks served from the cache
         self.preemptions = 0       # lanes evicted-and-requeued (OOB arena)
+        # -- speculative decode (serving/speculate.py): draft-k-then-
+        # verify accounting — acceptance_rate (accepted/proposed) is the
+        # number the draft model's cost trade is judged by
+        self.draft_proposed = 0    # draft tokens proposed to the target
+        self.draft_accepted = 0    # proposals the target agreed with
+        self.draft_rejected = 0    # proposals the target overruled
         self.shed_by_class: Dict[str, int] = {}  # 429s per SLO class
         # per-component depths (batcher rows / decode pending prompts):
         # one shared last-writer-wins field would let an idle component
@@ -176,6 +182,15 @@ class ServingStats:
         with self._lock:
             self.preemptions += 1
 
+    def record_draft(self, proposed: int, accepted: int) -> None:
+        """One speculative round's verdict: ``proposed`` draft tokens
+        scored by the target, of which ``accepted`` matched the target's
+        own greedy choice (the Leviathan et al. longest-prefix rule)."""
+        with self._lock:
+            self.draft_proposed += int(proposed)
+            self.draft_accepted += int(accepted)
+            self.draft_rejected += int(proposed) - int(accepted)
+
     def record_shed(self, slo_class: str) -> None:
         with self._lock:
             self.shed_by_class[slo_class] = \
@@ -238,6 +253,12 @@ class ServingStats:
                 "prefix_lookups": self.prefix_lookups,
                 "prefix_hits": self.prefix_hits,
                 "preemptions": self.preemptions,
+                "draft_proposed": self.draft_proposed,
+                "draft_accepted": self.draft_accepted,
+                "draft_rejected": self.draft_rejected,
+                "acceptance_rate": (
+                    round(self.draft_accepted / self.draft_proposed, 4)
+                    if self.draft_proposed else None),
                 "shed_by_class": dict(self.shed_by_class),
                 "queue_depth": sum(self.queue_depths.values()),
                 "queue_depths": dict(self.queue_depths),

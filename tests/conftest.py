@@ -114,6 +114,11 @@ _QUICK_FILES = {
     # flagship opt-tree scale state), bf16 KV arena sizing — tiny nets,
     # ~40s
     "test_lowprec.py",
+    # decode amortization (ISSUE 16): k-tick == k x 1-tick byte-identity
+    # across the paged contract matrix, speculative greedy == target-only
+    # greedy (chaos all-reject included), acceptance ledger arithmetic,
+    # knob registration — tiny LMs, ~30s
+    "test_speculate.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
