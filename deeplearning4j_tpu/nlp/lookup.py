@@ -68,6 +68,14 @@ class InMemoryLookupTable:
             return None
         return self.syn0[idx]
 
+    def vectors(self, indices) -> np.ndarray:
+        """Batched syn0 row lookup ``[N, vector_length]`` — the /embed
+        serving form of :meth:`vector` (retrieval/embed.LookupEmbedding
+        routes id rows here). Out-of-range ids raise like any numpy
+        index; callers clamp/validate upstream."""
+        idx = np.asarray(indices, np.int64).reshape(-1)
+        return self.syn0[idx]
+
     def similarity(self, w1: str, w2: str) -> float:
         """Cosine similarity (BasicModelUtils.similarity)."""
         v1, v2 = self.vector(w1), self.vector(w2)

@@ -281,6 +281,24 @@ _register("DL4J_TPU_ONLINE_GATE_AGREE", "0.0", "float",
           "promotion gate: minimum shadow-vs-primary argmax agreement "
           "fraction (0 disables the agreement gate)")
 
+# embedding & retrieval serving (retrieval/)
+_register("DL4J_TPU_EMBED_LAYER", "", "int",
+          "feed-forward embedding layer: int index into the MLN "
+          "activations list ('' = -2, the last hidden layer); CG vertex "
+          "selection is per-adapter, not env-driven")
+_register("DL4J_TPU_EMBED_POOL", "mean", "str",
+          "sequence pooling for BertMLM /embed contextual embeddings",
+          choices=("mean", "cls", "max"))
+_register("DL4J_TPU_ANN_ROWS", "0", "int",
+          "vector-index arena capacity in rows (0 = auto-size from "
+          "DL4J_TPU_HBM_GB via ops/memory.ann_arena_rows)")
+_register("DL4J_TPU_ANN_CLUSTERS", "0", "int",
+          "IVF coarse-quantizer cluster count (0 = auto ~= sqrt(rows))")
+_register("DL4J_TPU_ANN_NPROBE", "8", "int",
+          "IVF clusters probed per /search query (recall/qps dial; "
+          "measured recall@k vs the exact oracle rides "
+          "retrieval_stats.last_recall)")
+
 # bench / examples harness (bench.py, examples/)
 _register("DL4J_TPU_EXAMPLE_SMOKE", "", "flag",
           "any non-empty value shrinks every examples/*.py to smoke-tier "

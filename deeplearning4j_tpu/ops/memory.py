@@ -367,3 +367,37 @@ def kv_arena_blocks(cfg, block_tokens: int, *, params=None,
     blocks = int(max(0.0, budget) * float(kv_fraction) / per_block)
     floor = cfg.max_len // int(block_tokens) + 1
     return max(floor, min(int(max_blocks), blocks))
+
+
+# ---------------------------------------------------------------------------
+# ANN vector-arena sizing (the retrieval-side twin of kv_arena_blocks)
+# ---------------------------------------------------------------------------
+
+
+def ann_row_bytes(dim: int, dtype=np.float32) -> int:
+    """Device bytes of ONE index row: a [dim] vector in the arena dtype."""
+    return int(dim) * np.dtype(dtype).itemsize
+
+
+def ann_arena_rows(dim: int, *, params=None,
+                   hbm_gb: Optional[float] = None,
+                   ann_fraction: float = 0.25,
+                   max_rows: int = 1 << 20,
+                   min_rows: int = 1024, dtype=np.float32) -> int:
+    """How many vector rows the retrieval arena can afford under
+    ``DL4J_TPU_HBM_GB`` — the AOT sizing behind ``DL4J_TPU_ANN_ROWS=0``
+    (retrieval/store.VectorStore), pure closed-form arithmetic, no
+    device touch (tunnel-free, the kv_arena_blocks discipline).
+
+    Budget = HBM minus twice the encoder parameter bytes (weights
+    resident plus a transient dispatch copy), times ``ann_fraction``
+    (the serving KV arena and batcher programs own the rest), divided by
+    three row copies (published snapshot + staging arena + one transient
+    publish clone — the generation-swap publish keeps two arenas live
+    plus the copy in flight), clamped to [min_rows, max_rows]."""
+    budget = (hbm_gb if hbm_gb is not None else hbm_budget_gb()) * 2.0**30
+    if params is not None:
+        budget -= 2.0 * _tree_bytes(params)
+    per_row = 3 * ann_row_bytes(dim, dtype)
+    rows = int(max(0.0, budget) * float(ann_fraction) / per_row)
+    return max(int(min_rows), min(int(max_rows), rows))

@@ -92,6 +92,7 @@ from deeplearning4j_tpu.serving.batcher import (
     QueueFullError,
     RequestTimeoutError,
 )
+from deeplearning4j_tpu.retrieval.stats import RetrievalStats
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.resilience import (
     BreakerOpenError,
@@ -178,6 +179,17 @@ class ServingEngine:
         self._old_handlers: Dict[int, Any] = {}
         self.registry = ModelRegistry(chaos=chaos, stats=self.stats)
         self._batchers: Dict[str, DynamicBatcher] = {}
+        # /embed rides its OWN per-record batchers (ISSUE 17): embedding
+        # rows and /predict rows share a model but not an output shape,
+        # and the DynamicBatcher contract is one infer fn per queue
+        self._embed_batchers: Dict[str, DynamicBatcher] = {}
+        # named retrieval/store.VectorStore instances behind /search;
+        # engine-level embed/search counters ride the same ledger class
+        # the stores register per-index
+        self._indexes: Dict[str, Any] = {}
+        self.retrieval_stats = RetrievalStats()
+        _metrics.register_ledger(self, "retrieval_stats",
+                                 self.retrieval_stats)
         self._decoders: Dict[str, Any] = {}
         self._no_decoder: set = set()  # records probed and found ineligible
         self._lock = threading.Lock()       # naive path + generate serialization
@@ -290,6 +302,148 @@ class ServingEngine:
         shadow = self._shadow
         if shadow is not None:
             shadow.offer(x, out)
+
+    # -- embedding & retrieval plane (ISSUE 17, retrieval/) ----------------
+
+    def embed_for(self, name, version, x,
+                  timeout_s: Optional[float] = None,
+                  layer=None, pool: Optional[str] = None) -> np.ndarray:
+        """Encode rows to embeddings [N, dim] through the registered
+        model's adapter (registry.ModelRecord.embed_adapter) — the same
+        admission gate, dynamic batcher, and bucket ladder as /predict,
+        so batcher==direct byte-equivalence holds by the same argument
+        (per-request slices of a row-independent coalesced dispatch)."""
+        rec = self.registry.get(name, version)
+        breaker = self._admit(rec)
+        if rec.model is None:
+            raise KeyError(f"{rec.key} is unloaded")
+        x = np.asarray(x)
+        rid = next(self._rid)
+        with obs_trace.span("serve.request", rid=rid, model=rec.key,
+                            rows=int(x.shape[0]), kind="embed"):
+            if not self.batching_enabled:
+                try:
+                    out = self._direct_embed(rec, x, layer, pool)
+                except ClientRequestError:
+                    raise  # payload error: no breaker vote either way
+                except Exception as e:  # noqa: BLE001 — serving boundary
+                    breaker.record_failure(f"{type(e).__name__}: {e}")
+                    raise
+                breaker.record_success()
+            else:
+                batcher = self._embed_batcher_for(rec, layer, pool)
+                out = batcher.predict(x, timeout_s=timeout_s, rid=rid)
+        self.retrieval_stats.bump("embed_requests")
+        self.retrieval_stats.bump("embed_rows", int(x.shape[0]))
+        return out
+
+    def embed(self, x, timeout_s: Optional[float] = None) -> np.ndarray:
+        """Default-model form of :meth:`embed_for`."""
+        return self.embed_for(None, None, x, timeout_s=timeout_s)
+
+    def _embed_rows(self, rec, x: np.ndarray, layer, pool) -> np.ndarray:
+        """The one embed compute path both the direct call and the
+        batcher's coalesced dispatch run: shape/normalize like /predict,
+        pad up the bucket ladder (pad rows are zero and SLICED off — the
+        encoders are row-independent, so they are inert by construction),
+        encode, un-pad."""
+        from deeplearning4j_tpu.ops import dispatch
+
+        adapter = rec.embed_adapter(layer=layer, pool=pool)
+        batch = self._shape_rows(rec, x)
+        n = int(batch.shape[0])
+        bucket = dispatch.bucket_size(n)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + batch.shape[1:], batch.dtype)
+            batch = np.concatenate([batch, pad])
+        out = np.asarray(adapter(batch))
+        return out[:n]
+
+    def _direct_embed(self, rec, x: np.ndarray, layer, pool) -> np.ndarray:
+        with self._lock:
+            return self._embed_rows(rec, x, layer, pool)
+
+    def _embed_batcher_for(self, rec, layer=None,
+                           pool: Optional[str] = None) -> DynamicBatcher:
+        with self._engine_lock:
+            batcher = self._embed_batchers.get(rec.key)
+            if batcher is None:
+                chaos = self.chaos
+
+                def infer(batch, _rec=rec, _layer=layer, _pool=pool):
+                    if chaos is not None:
+                        chaos.on_infer()
+                    return self._embed_rows(_rec, np.asarray(batch),
+                                            _layer, _pool)
+
+                batcher = DynamicBatcher(
+                    infer, max_batch=self.max_batch,
+                    max_wait_ms=self.max_wait_ms,
+                    queue_capacity=self.queue_capacity,
+                    default_timeout_s=self.request_timeout_s,
+                    stats=self.stats,
+                    watchdog_s=self.watchdog_s,
+                    on_outcome=self._outcome_hook(rec),
+                    on_wedged=self._wedged_hook(rec))
+                self._embed_batchers[rec.key] = batcher
+            return batcher
+
+    def register_index(self, name: str, store) -> None:
+        """Attach a retrieval/store.VectorStore behind /search."""
+        with self._engine_lock:
+            self._indexes[str(name)] = store
+
+    def unregister_index(self, name: str):
+        with self._engine_lock:
+            return self._indexes.pop(str(name), None)
+
+    def index(self, name: str):
+        store = self._indexes.get(str(name))
+        if store is None:
+            raise ClientRequestError(f"no index named {name!r}")
+        return store
+
+    def search(self, index_name, queries, k: int = 10,
+               nprobe: Optional[int] = None):
+        """Top-k over a registered index's CURRENT published generation
+        (ids, scores). Lock-free against publishes — a concurrent
+        generation swap can never fail an admitted search (the store's
+        snapshot discipline)."""
+        if self._draining:
+            self.stats.record_fast_fail()
+            raise DrainingError("engine is draining; admission closed")
+        store = self.index(index_name)
+        rid = next(self._rid)
+        q = np.asarray(queries, np.float32)
+        with obs_trace.span("serve.request", rid=rid, index=str(index_name),
+                            rows=int(q.shape[0]) if q.ndim > 1 else 1,
+                            kind="search"):
+            return store.search(q, k=k, nprobe=nprobe)
+
+    def embed_report(self) -> Dict[str, Any]:
+        """Per-model embedding dim + adapter kind for /models — AOT
+        (config/param shapes/eval_shape), never a model dispatch, so it
+        answers tunnel-free beside kv_report."""
+        out: Dict[str, Any] = {}
+        for d in self.registry.describe():
+            if d["state"] in ("broken", "unloaded"):
+                continue
+            rec = self.registry.get(d["name"], d["version"])
+            if rec is None or rec.model is None:
+                continue
+            try:
+                adapter = rec.embed_adapter()
+            except TypeError:
+                continue  # no embedding surface on this model family
+            out[rec.key] = {"kind": adapter.kind, "dim": adapter.dim}
+        return out
+
+    def index_report(self) -> Dict[str, Any]:
+        """Per-index capacity/row-count/generation for /models (the
+        stores' own AOT accounting)."""
+        with self._engine_lock:
+            stores = dict(self._indexes)
+        return {name: store.report() for name, store in stores.items()}
 
     def generate(self, tokens: np.ndarray, n_new: int, *,
                  temperature: float = 1.0, seed: int = 0,
@@ -685,6 +839,11 @@ class ServingEngine:
                         # serve()-swap history (ISSUE 14 satellite): the
                         # audited rollback trail — who replaced whom, when
                         "lineage": engine.registry.lineage(),
+                        # retrieval plane (ISSUE 17 satellite): per-model
+                        # embedding dims + per-index capacity/rows, both
+                        # AOT — answered with the tunnel down
+                        "embed": engine.embed_report(),
+                        "indexes": engine.index_report(),
                     })
                 else:
                     self._send(404, {"error": "not found"})
@@ -693,6 +852,10 @@ class ServingEngine:
                 try:
                     if self.path == "/predict":
                         self._do_predict()
+                    elif self.path == "/embed":
+                        self._do_embed()
+                    elif self.path == "/search":
+                        self._do_search()
                     elif self.path == "/generate":
                         self._do_generate()
                     elif self.path == "/models":
@@ -758,6 +921,51 @@ class ServingEngine:
                 key = "outputs" if "batch" in payload else "output"
                 val = out.tolist() if "batch" in payload else out[0].tolist()
                 self._send(200, {key: val})
+
+            def _do_embed(self):
+                payload = self._read_json()
+                if "record" in payload:
+                    x = np.asarray(payload["record"], np.float32)[None]
+                elif "batch" in payload:
+                    x = np.asarray(payload["batch"], np.float32)
+                elif "tokens" in payload:
+                    # token-id rows (BERT / word2vec lookup): keep them
+                    # integral through the float envelope
+                    x = np.asarray(payload["tokens"])
+                    if x.ndim == 1:
+                        x = x[None]
+                else:
+                    self._send(400, {"error": "need record|batch|tokens"})
+                    return
+                timeout = payload.get("timeout_s")
+                layer = payload.get("layer")
+                out = engine.embed_for(
+                    payload.get("model"), payload.get("version"), x,
+                    timeout_s=(float(timeout) if timeout is not None
+                               else None),
+                    layer=layer, pool=payload.get("pool"))
+                key = "embeddings" if ("batch" in payload
+                                       or "tokens" in payload) else "embedding"
+                val = (out.tolist() if key == "embeddings"
+                       else out[0].tolist())
+                self._send(200, {key: val, "dim": int(out.shape[-1])})
+
+            def _do_search(self):
+                payload = self._read_json()
+                if "queries" in payload:
+                    q = np.asarray(payload["queries"], np.float32)
+                elif "query" in payload:
+                    q = np.asarray(payload["query"], np.float32)[None]
+                else:
+                    self._send(400, {"error": "need query|queries"})
+                    return
+                nprobe = payload.get("nprobe")
+                ids, scores = engine.search(
+                    payload.get("index", "default"), q,
+                    k=int(payload.get("k", 10)),
+                    nprobe=int(nprobe) if nprobe is not None else None)
+                self._send(200, {"ids": ids.tolist(),
+                                 "scores": scores.tolist()})
 
             def _do_generate(self):
                 payload = self._read_json()
@@ -945,11 +1153,14 @@ class ServingEngine:
         rec = self.registry.get(name, version)
         with self._engine_lock:
             batcher = self._batchers.pop(rec.key, None)
+            embed_batcher = self._embed_batchers.pop(rec.key, None)
             decoder = self._decoders.pop(rec.key, None)
             self._no_decoder.discard(rec.key)
             self._breakers.pop(rec.key, None)
         if batcher is not None:
             batcher.stop()
+        if embed_batcher is not None:
+            embed_batcher.stop()
         if decoder is not None:
             decoder.stop()
         self.registry.unload(rec.name, rec.version)
@@ -981,7 +1192,8 @@ class ServingEngine:
         obs_journal.event("serve.drain", drain_s=budget)
         deadline = time.monotonic() + budget
         with self._engine_lock:
-            batchers = list(self._batchers.values())
+            batchers = (list(self._batchers.values())
+                        + list(self._embed_batchers.values()))
             decoders = list(self._decoders.values())
         ok = True
         for b in batchers:
@@ -1015,9 +1227,11 @@ class ServingEngine:
         if self._thread:
             self._thread.join(timeout=5)
         with self._engine_lock:
-            batchers = list(self._batchers.values())
+            batchers = (list(self._batchers.values())
+                        + list(self._embed_batchers.values()))
             decoders = list(self._decoders.values())
             self._batchers.clear()
+            self._embed_batchers.clear()
             self._decoders.clear()
         for b in batchers:
             b.stop()

@@ -84,6 +84,10 @@ class ModelRecord:
         # mode: ONE quantization per record however many decoders the
         # engine (re)builds around it
         self._drafts: Dict[str, Any] = {}
+        # embedding adapters (ISSUE 17), cached per (layer, pool): the
+        # /embed encoder reuses one adapter (and its compiled program
+        # chain through the bucket ladder) across every request
+        self._embedders: Dict[Tuple[Any, Any], Any] = {}
 
     @property
     def key(self) -> str:
@@ -110,6 +114,27 @@ class ModelRecord:
             draft = lowprec.draft_lm(self.model, mode)
             self._drafts[mode] = draft
         return draft
+
+    def embed_adapter(self, layer=None, pool: Optional[str] = None):
+        """The embedding encoder over this record's model
+        (retrieval/embed.resolve_adapter — MLN/CG hidden layer, BERT
+        pooled embed_tokens, or word2vec lookup), cached per
+        (layer, pool) like draft_net so repeat /embed batcher builds
+        reuse one adapter and its compiled programs. Resolution never
+        RUNS the model (dims come from config/param shapes/eval_shape —
+        tunnel-free, the /models AOT contract)."""
+        if self.model is None:
+            raise ValueError(
+                f"record {self.key} has no model (state={self.state})")
+        key = (layer, pool)
+        adapter = self._embedders.get(key)
+        if adapter is None:
+            from deeplearning4j_tpu.retrieval.embed import resolve_adapter
+
+            adapter = resolve_adapter(self.model, layer=layer, pool=pool,
+                                      input_shape=self.input_shape)
+            self._embedders[key] = adapter
+        return adapter
 
     def describe(self) -> Dict[str, Any]:
         out = {

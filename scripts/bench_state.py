@@ -35,8 +35,8 @@ EXPECTED = [
     "serving_resilience", "serving_decode", "serving_fleet",
     "decode_amortize", "checkpoint_overhead",
     "input_pipeline",
-    "elastic_dp", "online_loop", "lowprec", "obs_overhead", "paged_kernel",
-    "sgns_kernel",
+    "elastic_dp", "online_loop", "lowprec", "retrieval", "obs_overhead",
+    "paged_kernel", "sgns_kernel",
     "reference_cpu_lenet5_torch", "lenet5_cpu",
     "char_rnn_cpu", "native_feed", "scaling_virtual8",
 ]

@@ -119,6 +119,11 @@ _QUICK_FILES = {
     # greedy (chaos all-reject included), acceptance ledger arithmetic,
     # knob registration — tiny LMs, ~30s
     "test_speculate.py",
+    # embedding & retrieval plane (ISSUE 17): /embed batcher==direct
+    # byte-equivalence (pad rows inert), exact-index vs numpy oracle,
+    # MEASURED IVF recall, zero-failed-/search across a generation swap,
+    # drift veto, knob/ledger registration — tiny nets, ~20s
+    "test_retrieval.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
