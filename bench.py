@@ -1893,6 +1893,186 @@ def bench_decode_amortize(k=4, n_new=24):
 
 
 # ---------------------------------------------------------------------------
+# serving_mesh: mesh-sharded decode + prefill/decode disaggregation
+# (ISSUE 18 — serving/mesh.py). CPU-only by design: the byte-identity
+# claim and the per-device capacity closed form are backend-invariant,
+# and the virtual 8-device mesh exercises the real shard_map programs.
+# ---------------------------------------------------------------------------
+
+_SERVING_MESH_SCRIPT = r"""
+import json, os, sys, time
+
+# the sharded tick needs the virtual multi-device CPU platform BEFORE
+# jax initializes (same discipline as tests/conftest.py)
+os.environ["XLA_FLAGS"] = " ".join(
+    [f for f in os.environ.get("XLA_FLAGS", "").split()
+     if "xla_force_host_platform_device_count" not in f]
+    + ["--xla_force_host_platform_device_count=8"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+mesh_d, n_new = int(sys.argv[1]), int(sys.argv[2])
+
+import urllib.request
+
+from deeplearning4j_tpu.models.transformer import (TransformerConfig,
+                                                   TransformerLM)
+from deeplearning4j_tpu.ops import memory as opsmem
+from deeplearning4j_tpu.serving.engine import ServingEngine
+from deeplearning4j_tpu.serving.mesh import MeshPagedDecoder
+from deeplearning4j_tpu.serving.paged import PagedDecoder
+from deeplearning4j_tpu.serving.router import FleetRouter
+
+BLOCK, STREAMS = 8, 4
+cfg = TransformerConfig(vocab_size=64, d_model=64, n_layers=2,
+                        n_heads=mesh_d, d_ff=128, max_len=128,
+                        use_flash=False)
+lm = TransformerLM(cfg)
+n_blocks = STREAMS * cfg.max_len // BLOCK
+rng = np.random.default_rng(0)
+prompts = [rng.integers(1, 64, 12).astype(np.int32) for _ in range(STREAMS)]
+
+
+def run(make):
+    # warm pass compiles every program, then a fresh decoder for the
+    # timed pass (the decode_amortize methodology)
+    for timed in (False, True):
+        d = make()
+        try:
+            t0 = time.perf_counter()
+            futs = [d.submit(p, n_new, temperature=0.0, timeout_s=600)
+                    for p in prompts]
+            futs.append(d.submit(prompts[0], n_new, temperature=0.8,
+                                 seed=11, timeout_s=600))
+            outs = [np.asarray(f.result(timeout=600)).tolist()
+                    for f in futs]
+            wall = time.perf_counter() - t0
+            if timed:
+                return outs, {
+                    "wall_s": round(wall, 3),
+                    "tokens_per_sec": round(
+                        (STREAMS + 1) * n_new / wall, 1),
+                }
+        finally:
+            d.stop()
+
+
+dense_o, dense_row = run(lambda: PagedDecoder(
+    lm, block_tokens=BLOCK, n_blocks=n_blocks))
+mesh_o, mesh_row = run(lambda: MeshPagedDecoder(
+    lm, devices=mesh_d, block_tokens=BLOCK, n_blocks=n_blocks))
+# the contract everything rides on: sharded tick == solo tick, bitwise,
+# greedy AND sampled lanes co-resident
+assert mesh_o == dense_o
+
+# per-device arena accounting: same per-device HBM budget admits ~d x
+# the global blocks (ops/memory closed form, tunnel-free; budget small
+# enough that neither side clamps at max_blocks)
+blocks_1 = opsmem.kv_arena_blocks(cfg, BLOCK, hbm_gb=0.002)
+blocks_d = opsmem.kv_arena_blocks(cfg, BLOCK, hbm_gb=0.002,
+                                  devices=mesh_d)
+
+# disaggregation: prefill-role + decode-role engines behind the
+# role-aware router; every admitted /generate answered, byte-equal to
+# a solo engine
+prompt = [int(t) for t in prompts[0]]
+
+
+def post(url, path, payload):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=600) as r:
+        return json.loads(r.read())
+
+
+solo = ServingEngine(model=lm, kv_block=BLOCK,
+                     kv_blocks=n_blocks).start()
+try:
+    want = post(solo.url, "/generate",
+                {"tokens": prompt, "n_new": n_new,
+                 "temperature": 0.0})["tokens"][0]
+finally:
+    solo.stop()
+
+pre = ServingEngine(model=lm, kv_block=BLOCK, kv_blocks=n_blocks,
+                    role="prefill").start()
+dec = ServingEngine(model=lm, kv_block=BLOCK, kv_blocks=n_blocks,
+                    role="decode").start()
+router = FleetRouter(replicas={
+    "p0": {"url": pre.url, "role": "prefill"},
+    "d0": {"url": dec.url, "role": "decode"},
+}).start()
+n_req, walls = 8, []
+try:
+    for _ in range(n_req):
+        t0 = time.perf_counter()
+        got = post(router.url, "/generate",
+                   {"tokens": prompt, "n_new": n_new,
+                    "temperature": 0.0})["tokens"][0]
+        walls.append(time.perf_counter() - t0)
+        assert got == want
+    rsnap = router.stats.snapshot()
+    dsnap = dec.stats.snapshot()
+    psnap = pre.stats.snapshot()
+finally:
+    router.stop()
+    pre.stop()
+    dec.stop()
+
+walls.sort()
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "mesh_devices": mesh_d,
+    "streams": STREAMS + 1,
+    "n_new": n_new,
+    "dense": dense_row,
+    "mesh": mesh_row,
+    "byte_identical": True,
+    "kv_blocks_1dev": blocks_1,
+    "kv_blocks_mesh": blocks_d,
+    "kv_capacity_ratio": round(blocks_d / max(1, blocks_1), 2),
+    "disagg_requests": n_req,
+    "disagg_failed": n_req - dsnap["completed"],
+    "prefill_handoffs": rsnap["prefill_handoffs"],
+    "prefill_fallbacks": rsnap["prefill_fallbacks"],
+    "prefix_imports": dsnap["prefix_imports"],
+    "prefill_decode_tokens": psnap["generated_tokens"],
+    "disagg_p50_ms": round(walls[len(walls) // 2] * 1e3, 1),
+    "disagg_p99_ms": round(walls[-1] * 1e3, 1),
+    "stat": "one timed pass per decoder after a warm pass (4 greedy + "
+            "1 sampled co-resident lanes); handoff counters from the "
+            "router/serving ledgers",
+    "note": "CPU row — the virtual mesh shards over one physical core, "
+            "so mesh tokens/s bounds program overhead, not the TP win; "
+            "byte-identity and the capacity closed form are the "
+            "backend-invariant proof, chip tokens/s lands at tunnel "
+            "contact",
+}))
+"""
+
+
+def bench_serving_mesh(mesh_devices=4, n_new=16):
+    """Mesh-sharded inference leg (serving/mesh.py): sharded-tick ==
+    solo-tick byte-identity with greedy + sampled lanes co-resident,
+    the per-device KV capacity closed form (capacity scales with the
+    mesh at a fixed per-device budget), and the prefill/decode
+    disaggregated fleet answering every admitted /generate byte-equal
+    to a solo engine (handoff counters as evidence). Subprocess-
+    isolated, CPU-only by design — the virtual 8-device mesh runs the
+    real shard_map programs; chip tokens/s lands at tunnel contact."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _SERVING_MESH_SCRIPT, str(mesh_devices),
+         str(n_new)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
 # checkpoint_overhead: sync vs async checkpointing cost (resilience/)
 # ---------------------------------------------------------------------------
 
@@ -3460,7 +3640,7 @@ _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
                   "obs_overhead", "paged_kernel", "sgns_kernel",
-                  "online_loop", "lowprec", "retrieval"}
+                  "online_loop", "lowprec", "retrieval", "serving_mesh"}
 
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_PARTIAL.json")
@@ -3725,6 +3905,8 @@ def main():
         streams=16, n_new=12 if quick else 24)
     run("decode_amortize", bench_decode_amortize,
         k=4, n_new=12 if quick else 24)
+    run("serving_mesh", bench_serving_mesh,
+        mesh_devices=4, n_new=10 if quick else 16)
     run("serving_resilience", bench_serving_resilience,
         per_client=4 if quick else 8)
     run("serving_fleet", bench_serving_fleet,

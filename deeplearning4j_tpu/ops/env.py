@@ -211,6 +211,19 @@ _register("DL4J_TPU_SERVE_SPEC", "", "str",
 _register("DL4J_TPU_SERVE_SPEC_K", "4", "int",
           "draft tokens proposed per speculative round (the target "
           "verifies k+1 positions in one dispatch)")
+_register("DL4J_TPU_SERVE_MESH", "0", "int",
+          "serving-mesh device count for the paged /generate plane: "
+          ">= 2 runs the decode tick TP-style under shard_map over that "
+          "many devices (attention heads + KV arena head-sharded, "
+          "serving/mesh.MeshPagedDecoder — byte-identical to the "
+          "single-device tick); 0/'' = single-device decoders")
+_register("DL4J_TPU_SERVE_ROLE", "", "enum",
+          "serving replica role for prefill/decode disaggregation: "
+          "prefill = own long-prompt prefill and export primed KV "
+          "blocks (/prefill), decode = own the latency-critical decode "
+          "tick, '' = both; published in the replica-<id>.addr JSON so "
+          "the FleetRouter routes /generate by role",
+          choices=("", "prefill", "decode"))
 _register("DL4J_TPU_SERVE_FLEET_REPLICAS", "2", "int",
           "serving-fleet replica count (ServingFleet default)")
 _register("DL4J_TPU_SERVE_ROUTER_PORT", "0", "int",

@@ -329,41 +329,55 @@ def auto_fit_transformer(cfg, *, batches=(32, 16, 8, 4),
 # ---------------------------------------------------------------------------
 
 
-def kv_block_bytes(cfg, block_tokens: int, dtype=None) -> int:
-    """Device bytes of ONE paged KV block across all layers: K and V,
-    [n_layers, block_tokens, n_heads, head_dim] each, in the arena dtype
-    (serving/paged.py's layout). ``dtype=None`` resolves through
-    ops/lowprec.kv_dtype — the model's compute dtype unless
+def kv_block_bytes(cfg, block_tokens: int, dtype=None,
+                   devices: int = 1) -> int:
+    """PER-DEVICE bytes of ONE paged KV block across all layers: K and
+    V, [n_layers, block_tokens, n_heads/devices, head_dim] each, in the
+    arena dtype (serving/paged.py's layout). ``dtype=None`` resolves
+    through ops/lowprec.kv_dtype — the model's compute dtype unless
     ``DL4J_TPU_SERVE_KV_DTYPE`` overrides it (bf16 halves KV bytes, so
-    the same HBM budget admits ~2x tokens)."""
+    the same HBM budget admits ~2x tokens). ``devices`` is the serving
+    mesh width (serving/mesh.py head-shards the arena, so each device
+    holds only its n_heads/devices slice of every block); closed-form
+    AOT arithmetic, no device touch (tunnel-free)."""
     from deeplearning4j_tpu.ops import lowprec
 
     if dtype is None:
         dtype = lowprec.kv_dtype(cfg)
+    devices = max(1, int(devices))
     hd = cfg.d_model // cfg.n_heads
+    heads_local = -(-cfg.n_heads // devices)  # ceil: honest off-grid
     itemsize = np.dtype(dtype).itemsize
-    return 2 * cfg.n_layers * int(block_tokens) * cfg.n_heads * hd * itemsize
+    return 2 * cfg.n_layers * int(block_tokens) * heads_local * hd \
+        * itemsize
 
 
 def kv_arena_blocks(cfg, block_tokens: int, *, params=None,
                     hbm_gb: Optional[float] = None,
                     kv_fraction: float = 0.5,
-                    max_blocks: int = 4096, dtype=None) -> int:
-    """How many KV blocks the arena can afford under ``DL4J_TPU_HBM_GB``.
+                    max_blocks: int = 4096, dtype=None,
+                    devices: int = 1) -> int:
+    """How many KV blocks the arena can afford under ``DL4J_TPU_HBM_GB``
+    (interpreted PER DEVICE when ``devices`` > 1).
 
     Budget = HBM minus twice the parameter bytes (weights resident plus
-    one transient copy for dispatch headroom), times ``kv_fraction``
-    (the rest stays free for prefill temporaries and the serving
-    batcher's bucket programs), divided by :func:`kv_block_bytes`.
-    Clamped to [one max_len sequence + 1, max_blocks] so a tiny budget
-    still yields a decoder that can serve a single request and a huge
-    one doesn't balloon the tick's gather. This replaces the fixed
-    pool's ``slots * max_len`` over-allocation with sizing from the
-    accounting plane (ISSUE 11 satellite)."""
+    one transient copy for dispatch headroom; the serving mesh
+    REPLICATES params — projections are column-sliced at trace time —
+    so param bytes are NOT divided by ``devices``), times
+    ``kv_fraction`` (the rest stays free for prefill temporaries and
+    the serving batcher's bucket programs), divided by
+    :func:`kv_block_bytes` at that device count — head-sharding drops
+    per-device block bytes to 1/devices, so capacity scales ~linearly
+    with the mesh. Clamped to [one max_len sequence + 1, max_blocks] so
+    a tiny budget still yields a decoder that can serve a single
+    request and a huge one doesn't balloon the tick's gather. This
+    replaces the fixed pool's ``slots * max_len`` over-allocation with
+    sizing from the accounting plane (ISSUE 11 satellite; ``devices``
+    is the ISSUE 18 mesh-serving satellite)."""
     budget = (hbm_gb if hbm_gb is not None else hbm_budget_gb()) * 2.0**30
     if params is not None:
         budget -= 2.0 * _tree_bytes(params)
-    per_block = kv_block_bytes(cfg, block_tokens, dtype)
+    per_block = kv_block_bytes(cfg, block_tokens, dtype, devices)
     blocks = int(max(0.0, budget) * float(kv_fraction) / per_block)
     floor = cfg.max_len // int(block_tokens) + 1
     return max(floor, min(int(max_blocks), blocks))

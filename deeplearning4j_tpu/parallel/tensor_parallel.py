@@ -204,6 +204,29 @@ def column_parallel_dense(W, b, x, mesh: Mesh, *, axis: str = MODEL_AXIS,
     )(W, b, x)
 
 
+def local_head_columns(W, *, num_heads: int, head_dim: int,
+                       n_devices: int, axis: str = MODEL_AXIS):
+    """This device's head-columns of a REPLICATED projection W [F, H*hd]
+    — the column-parallel partition of :data:`TP_BLOCK_SPECS` in its
+    BYTEWISE form, for use inside a shard_map body (serving/mesh.py's
+    decode tick).
+
+    Column-parallel QKV is exact, not approximate: every output column
+    of ``x @ W`` is an independent dot product, so
+    ``(x @ W)[:, cols] == x @ W[:, cols]`` element-for-element — no
+    float reduction is split or reordered. Slicing the replicated W at
+    trace time by ``lax.axis_index`` keeps one params copy per device
+    (no resharded second tree) while the compute still runs only the
+    local ``num_heads / n_devices`` heads' columns. The serving tick
+    needs this form (rather than `shard_tp_params` + row-parallel Wo)
+    because its acceptance bar is BYTE-identity with the single-device
+    program: a Megatron psum after Wo would reorder the output
+    contraction's float sum."""
+    cols = (num_heads // n_devices) * head_dim
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(W, idx * cols, cols, axis=1)
+
+
 def row_parallel_dense(W, b, x_sharded, mesh: Mesh, *, axis: str = MODEL_AXIS):
     """y = x @ W + b with W [H, F] sharded on H and x [..., H] sharded on its
     last dim; ONE psum replicates the output."""

@@ -68,6 +68,7 @@ Resilience plane (ISSUE 8 — serving/resilience.py):
 
 from __future__ import annotations
 
+import base64
 import itertools
 import json
 import math
@@ -120,6 +121,8 @@ class ServingEngine:
                  slots: Optional[int] = None,
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
+                 mesh_devices: Optional[int] = None,
+                 role: Optional[str] = None,
                  slo_classes: Optional[str] = None,
                  breaker_fails: Optional[int] = None,
                  breaker_cooldown_s: float = 2.0,
@@ -144,6 +147,25 @@ class ServingEngine:
                             else _env_float("DL4J_TPU_SERVE_KV_BLOCK", 16))
         self.kv_blocks = int(kv_blocks if kv_blocks is not None
                              else _env_float("DL4J_TPU_SERVE_KV_BLOCKS", 0))
+        # mesh serving (ISSUE 18, serving/mesh.py): >= 2 shards the
+        # paged decode tick over that many devices; the decoder build
+        # GATES incompatible knobs loudly (never a silent dense
+        # fallback). The import is lazy so engines that never decode
+        # don't pull the mesh plane in.
+        self.mesh_devices = int(
+            mesh_devices if mesh_devices is not None
+            else _env_float("DL4J_TPU_SERVE_MESH", 0))
+        # prefill/decode disaggregation role: routing metadata published
+        # with the replica addr (serving/fleet.py); a prefill-role
+        # engine still answers everything — the ROUTER enforces the
+        # split, the role just declares intent
+        self.role = (role if role is not None
+                     else envknob.raw("DL4J_TPU_SERVE_ROLE", "")
+                     ).strip().lower()
+        if self.role not in ("", "prefill", "decode"):
+            raise ValueError(
+                f"DL4J_TPU_SERVE_ROLE {self.role!r} must be '', "
+                "'prefill' or 'decode'")
         # a typo'd operator spec must fail HERE, not collapse to FIFO
         self.slo_classes = parse_slo_classes(
             slo_classes if slo_classes is not None
@@ -569,6 +591,58 @@ class ServingEngine:
 
         return stream()
 
+    def prefill_for(self, name, version, tokens, n_new: int):
+        """Prefill half of the disaggregated handoff (serving/mesh role
+        split): run the paged pool's bucketed prompt prefill as its own
+        dispatch and return ``(digests, k_blocks, v_blocks,
+        block_tokens)`` — the full prompt blocks strictly below the
+        write block, content-addressed by the PrefixCache digest chain.
+        A decode replica adopts them via :meth:`prime_for`; the handoff
+        is best-effort by construction (a dropped transfer just means
+        the decode side recomputes the same bytes)."""
+        rec = self.registry.get(name, version)
+        breaker = self._admit(rec)
+        decoder = self._decoder_for(rec)
+        if decoder is None or not hasattr(decoder, "export_prefix"):
+            raise ClientRequestError(
+                f"model {rec.key} has no paged decoder to prefill")
+        prompt = np.asarray(tokens, np.int32).reshape(-1)
+        rid = next(self._rid)
+        with obs_trace.span("serve.request", rid=rid, model=rec.key,
+                            rows=1, kind="prefill"):
+            try:
+                digests, kb, vb = decoder.export_prefix(prompt,
+                                                        int(n_new))
+            except ClientRequestError:
+                raise  # payload mistakes are not model-health evidence
+            except Exception as e:  # noqa: BLE001 — serving boundary
+                breaker.record_failure(f"{type(e).__name__}: {e}")
+                raise
+        breaker.record_success()
+        return digests, kb, vb, int(decoder.block_tokens)
+
+    def prime_for(self, name, version, digests, k_blocks,
+                  v_blocks) -> int:
+        """Decode half of the handoff: adopt prefill-exported KV blocks
+        into the paged arena + prefix cache. Returns blocks adopted (a
+        partial adoption — already-cached digests, exhausted free list —
+        is fine: the next admission recomputes what was dropped)."""
+        rec = self.registry.get(name, version)
+        breaker = self._admit(rec)
+        decoder = self._decoder_for(rec)
+        if decoder is None or not hasattr(decoder, "import_prefix"):
+            raise ClientRequestError(
+                f"model {rec.key} has no paged decoder to prime")
+        try:
+            adopted = decoder.import_prefix(digests, k_blocks, v_blocks)
+        except ClientRequestError:
+            raise
+        except Exception as e:  # noqa: BLE001 — serving boundary
+            breaker.record_failure(f"{type(e).__name__}: {e}")
+            raise
+        breaker.record_success()
+        return int(adopted)
+
     # -- internals --------------------------------------------------------
     @staticmethod
     def _normalize_rows(rec, x: np.ndarray) -> np.ndarray:
@@ -710,6 +784,34 @@ class ServingEngine:
                 if getattr(rec.model, "_run_cfg", None) is None:
                     self._no_decoder.add(rec.key)
                     return None
+                paged_kw = dict(
+                    block_tokens=self.kv_block,
+                    n_blocks=self.kv_blocks or None,
+                    min_lanes=self.slots, stats=self.stats,
+                    default_timeout_s=max(self.request_timeout_s,
+                                          300.0),
+                    chaos=self.chaos,
+                    slo_classes=self.slo_classes or None,
+                    queue_cap=self.queue_capacity)
+                if self.mesh_devices >= 2:
+                    # DL4J_TPU_SERVE_MESH: an incompatibility here (bf16
+                    # KV dtype, spec mode, indivisible heads, no paged
+                    # pool) raises OUT of this method — a user who asked
+                    # for the sharded plane must never be silently
+                    # served by the dense single-device path
+                    if self.kv_block <= 0:
+                        raise ValueError(
+                            "DL4J_TPU_SERVE_MESH requires the paged KV "
+                            "pool (DL4J_TPU_SERVE_KV_BLOCK > 0); the "
+                            "fixed-slot pool has no sharded arena")
+                    from deeplearning4j_tpu.serving.mesh import (
+                        MeshPagedDecoder,
+                    )
+
+                    decoder = MeshPagedDecoder(
+                        rec.model, devices=self.mesh_devices, **paged_kw)
+                    self._decoders[rec.key] = decoder
+                    return decoder
                 try:
                     if self.kv_block > 0:
                         from deeplearning4j_tpu.ops import lowprec
@@ -717,15 +819,6 @@ class ServingEngine:
                             PagedDecoder,
                         )
 
-                        paged_kw = dict(
-                            block_tokens=self.kv_block,
-                            n_blocks=self.kv_blocks or None,
-                            min_lanes=self.slots, stats=self.stats,
-                            default_timeout_s=max(self.request_timeout_s,
-                                                  300.0),
-                            chaos=self.chaos,
-                            slo_classes=self.slo_classes or None,
-                            queue_cap=self.queue_capacity)
                         spec = lowprec.spec_mode()
                         if spec:
                             # DL4J_TPU_SERVE_SPEC: the paged pool gains
@@ -858,6 +951,10 @@ class ServingEngine:
                         self._do_search()
                     elif self.path == "/generate":
                         self._do_generate()
+                    elif self.path == "/prefill":
+                        self._do_prefill()
+                    elif self.path == "/prime":
+                        self._do_prime()
                     elif self.path == "/models":
                         self._do_models()
                     else:
@@ -1004,6 +1101,40 @@ class ServingEngine:
                     version=payload.get("version"))
                 self._send(200, {"tokens": out.tolist()})
 
+            def _do_prefill(self):
+                # prefill role surface (disaggregation): run the prompt
+                # prefill here, hand the caller the content-addressed
+                # block payload it forwards to a decode replica's /prime
+                payload = self._read_json()
+                toks = np.asarray(payload["tokens"], np.int32).reshape(-1)
+                digests, kb, vb, bt = engine.prefill_for(
+                    payload.get("model"), payload.get("version"),
+                    toks, int(payload.get("n_new", 16)))
+                self._send(200, {
+                    "digests": [d.hex() for d in digests],
+                    "k": base64.b64encode(
+                        np.ascontiguousarray(kb).tobytes()).decode(),
+                    "v": base64.b64encode(
+                        np.ascontiguousarray(vb).tobytes()).decode(),
+                    "shape": list(kb.shape),
+                    "dtype": str(kb.dtype),
+                    "block_tokens": int(bt),
+                })
+
+            def _do_prime(self):
+                payload = self._read_json()
+                shape = tuple(int(s) for s in payload["shape"])
+                dtype = np.dtype(str(payload["dtype"]))
+                kb = np.frombuffer(base64.b64decode(payload["k"]),
+                                   dtype).reshape(shape)
+                vb = np.frombuffer(base64.b64decode(payload["v"]),
+                                   dtype).reshape(shape)
+                digests = [bytes.fromhex(d) for d in payload["digests"]]
+                adopted = engine.prime_for(
+                    payload.get("model"), payload.get("version"),
+                    digests, kb, vb)
+                self._send(200, {"adopted": int(adopted)})
+
             def _stream_tokens(self, gen):
                 # manual chunked framing: one NDJSON object per token,
                 # flushed as sampled — a client reads tokens as the
@@ -1082,7 +1213,14 @@ class ServingEngine:
             rec = self.registry.get(d["name"], d["version"])
             if rec is None or rec.model is None:
                 continue
-            decoder = self._decoder_for(rec)
+            try:
+                decoder = self._decoder_for(rec)
+            except ValueError as e:
+                # a LOUD mesh-gate refusal (bf16 KV, spec mode,
+                # indivisible heads) must not 500 the whole /models GET
+                # — report it per record instead
+                out[rec.key] = {"error": str(e)}
+                continue
             if decoder is not None and hasattr(decoder, "kv_capacity"):
                 out[rec.key] = decoder.kv_capacity()
         return out
@@ -1130,6 +1268,11 @@ class ServingEngine:
                        for r in self.registry.describe()],
             "health": health,
         }
+        if self.role:
+            # disaggregation role (serving/mesh): only a role-TAGGED
+            # replica adds the key — the PR 12 plain-/health body stays
+            # byte-unchanged for unified engines
+            body["role"] = self.role
         return (200 if ok else 503), body
 
     def readiness(self):

@@ -74,7 +74,11 @@ class _ReplicaHandle:
 
     def start(self) -> None:
         self.board.register_worker(self.rid)
-        publish_replica_addr(self.fleet_dir, self.rid, self.engine.url)
+        # the engine's disaggregation role rides the addr JSON (ISSUE
+        # 18): the router learns the prefill/decode split from the same
+        # membership read that tells it where to connect
+        publish_replica_addr(self.fleet_dir, self.rid, self.engine.url,
+                             role=self.engine.role)
         self._thread = threading.Thread(target=self._beat, daemon=True,
                                         name=f"serve-hb-{self.rid}")
         self._thread.start()
@@ -124,6 +128,7 @@ class ServingFleet:
                  input_shape=None, normalizer=None,
                  heartbeat_s: float = 1.0,
                  chaos=None,
+                 roles: Optional[Dict[str, str]] = None,
                  engine_kwargs: Optional[Dict[str, Any]] = None,
                  router_kwargs: Optional[Dict[str, Any]] = None) -> None:
         from deeplearning4j_tpu.parallel.fleet import FileMembershipBoard
@@ -143,6 +148,9 @@ class ServingFleet:
         self.input_shape = input_shape
         self.normalizer = normalizer
         self.chaos = chaos
+        # rid -> 'prefill'|'decode'|'' — the disaggregation split
+        # (ISSUE 18); a restart re-spawns with the SAME role
+        self.roles = dict(roles or {})
         self.engine_kwargs = dict(engine_kwargs or {})
         self._lock = threading.Lock()
         self._handles: Dict[str, _ReplicaHandle] = {}
@@ -159,15 +167,19 @@ class ServingFleet:
             on_kill=self.kill_replica, **rkw)
 
     # -- replica lifecycle -------------------------------------------------
-    def _build_engine(self) -> ServingEngine:
+    def _build_engine(self, role: str = "") -> ServingEngine:
+        kw = dict(self.engine_kwargs)
+        if role:
+            kw["role"] = role
         eng = ServingEngine(model=self.model, model_path=self.model_path,
                             port=0, input_shape=self.input_shape,
-                            normalizer=self.normalizer,
-                            **self.engine_kwargs)
+                            normalizer=self.normalizer, **kw)
         return eng.start()
 
     def _spawn(self, rid: str) -> _ReplicaHandle:
-        handle = _ReplicaHandle(rid, self._build_engine(), self.board,
+        handle = _ReplicaHandle(rid,
+                                self._build_engine(self.roles.get(rid, "")),
+                                self.board,
                                 self.fleet_dir, self.heartbeat_s)
         handle.start()
         with self._lock:
@@ -268,7 +280,8 @@ def run_replica(*, fleet_dir: str, replica_id: str,
     engine.start()
     board = FileMembershipBoard(fleet_dir, heartbeat_timeout=heartbeat_s)
     board.register_worker(replica_id)
-    publish_replica_addr(fleet_dir, replica_id, engine.url)
+    publish_replica_addr(fleet_dir, replica_id, engine.url,
+                         role=engine.role)
     if ready_event is not None:
         ready_event.set()
     interval = max(0.01, min(0.25, heartbeat_s / 4.0))
@@ -302,6 +315,10 @@ def main(argv=None) -> int:
     ap.add_argument("--model-path", required=True)
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--heartbeat-s", type=float, default=1.0)
+    ap.add_argument("--role", default="",
+                    choices=("", "prefill", "decode"),
+                    help="disaggregation role published with the addr "
+                         "(default: DL4J_TPU_SERVE_ROLE)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin jax to the CPU substrate BEFORE first "
                          "backend use (the tunnel-safety rule)")
@@ -312,7 +329,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     run_replica(fleet_dir=args.fleet_dir, replica_id=args.replica_id,
                 model_path=args.model_path, port=args.port,
-                heartbeat_s=args.heartbeat_s)
+                heartbeat_s=args.heartbeat_s,
+                engine_kwargs=({"role": args.role} if args.role else None))
     return 0
 
 

@@ -267,6 +267,61 @@ def _paged_admit_for(cfg: TransformerConfig, width: int, block_tokens: int):
     return admit
 
 
+# prefill/decode disaggregation programs (ISSUE 18): the export runs the
+# SAME bucketed prefill an admission would, returning the block-shaped
+# KV instead of scattering it; the import is the scatter half alone,
+# applied to blocks computed elsewhere. Content addressing rides the
+# PrefixCache digest chain, so imported blocks are indistinguishable
+# from locally-prefilled cache entries.
+_PREFIX_EXPORT_CACHE: Dict[tuple, object] = {}
+_PREFIX_IMPORT_CACHE: Dict[tuple, object] = {}
+
+
+def _prefix_export_for(cfg: TransformerConfig, width: int,
+                       block_tokens: int, dtype):
+    key = (cfg, width, block_tokens, jnp.dtype(dtype).name)
+    fn = _PREFIX_EXPORT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    m = cfg.max_len // block_tokens
+    hd = cfg.d_model // cfg.n_heads
+
+    def export(params, window):
+        # the cast to the arena dtype happens IN-program, the same
+        # convert the admit scatter applies — exported bytes must equal
+        # what the importer's own prefill would have written
+        c1, _ = prefill_cache(params, window, cfg)
+        kb = c1["k"][:, 0].reshape(cfg.n_layers, m, block_tokens,
+                                   cfg.n_heads, hd)
+        vb = c1["v"][:, 0].reshape(cfg.n_layers, m, block_tokens,
+                                   cfg.n_heads, hd)
+        return kb.astype(dtype), vb.astype(dtype)
+
+    fn = jax.jit(export)
+    _PREFIX_EXPORT_CACHE[key] = fn
+    return fn
+
+
+def _prefix_import_for(cfg: TransformerConfig, block_tokens: int,
+                       table_width: int):
+    key = (cfg, block_tokens, int(table_width))
+    fn = _PREFIX_IMPORT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    def imp(arena, kb, vb, table):
+        # unadopted table entries point at trash block 0 and scatter
+        # zeros there — invisible under the causal mask, the same
+        # argument the admit path's write_table makes
+        ak = arena["k"].at[:, table].set(kb.astype(arena["k"].dtype))
+        av = arena["v"].at[:, table].set(vb.astype(arena["v"].dtype))
+        return {"k": ak, "v": av}
+
+    fn = dispatch.arena_jit(imp, donate=(0,))
+    _PREFIX_IMPORT_CACHE[key] = fn
+    return fn
+
+
 class BlockArena:
     """Host-side allocator for the device block arena: a free list plus
     per-block refcounts (prefix-shared blocks are held by every reader
@@ -439,6 +494,10 @@ class PagedDecoder:
                              "(capacity routing is batch-dependent)")
         self.lm = lm
         self.cfg = cfg
+        # every device program reads params through this alias so the
+        # mesh subclass (serving/mesh.py) can swap in a replicated
+        # placement without re-plumbing the call sites
+        self._infer_params = lm.params
         bt = max(1, min(int(block_tokens), cfg.max_len))
         while cfg.max_len % bt:
             bt //= 2
@@ -448,8 +507,11 @@ class PagedDecoder:
         # so the auto-sized arena admits ~2x tokens on the same budget
         self.kv_dtype = jnp.dtype(lowprec.kv_dtype(cfg))
         if n_blocks is None:
+            # per-device accounting: the mesh subclass head-shards the
+            # arena, so each device prices only H/d heads per block
             n_blocks = opsmem.kv_arena_blocks(cfg, bt, params=lm.params,
-                                              dtype=self.kv_dtype)
+                                              dtype=self.kv_dtype,
+                                              devices=self.mesh_devices)
         self.n_blocks = int(n_blocks)
         if self.n_blocks < self.table_width + 1:
             raise ValueError(
@@ -503,19 +565,34 @@ class PagedDecoder:
         # amortization win at /metrics
         self.dispatch_stats = dispatch.DispatchStats()
         register_net(self)
+        # handed-off prefix blocks waiting for the worker to adopt them
+        # (prefill/decode disaggregation — the worker owns the donated
+        # arena, so imports must run on its thread)
+        self._imports: deque = deque()
         # per-k tick memo: the attention path is resolved ONCE per k at
         # first use (construction-time for k=1, matching the old
         # self._tick behavior) — not per iteration, where the kernel
         # gate's measured-win lookup would run per generated token
-        self._ticks: Dict[int, object] = {1: _paged_tick_for(cfg, bt)}
+        self._ticks: Dict[int, object] = {1: self._build_tick(1)}
         self._start_worker()
 
     def _tick_fn(self, k: int):
         fn = self._ticks.get(k)
         if fn is None:
-            fn = _paged_tick_for(self.cfg, self.block_tokens, k)
+            fn = self._build_tick(k)
             self._ticks[k] = fn
         return fn
+
+    # -- program builders (overridden by serving/mesh.py) ----------------
+    def _build_tick(self, k: int):
+        return _paged_tick_for(self.cfg, self.block_tokens, k)
+
+    def _build_admit(self, width: int):
+        return _paged_admit_for(self.cfg, width, self.block_tokens)
+
+    def _build_import(self):
+        return _prefix_import_for(self.cfg, self.block_tokens,
+                                  self.table_width)
 
     def _start_worker(self) -> None:
         """Factored out so subclasses (serving/speculate.py) can finish
@@ -525,6 +602,7 @@ class PagedDecoder:
         self._worker.start()
 
     supports_streaming = True  # engine.generate_stream dispatches on this
+    mesh_devices = 1  # serving-mesh width; MeshPagedDecoder overrides
 
     def _reset_arena(self) -> None:
         """Fresh zeroed arena + allocator + prefix cache. Construction
@@ -534,16 +612,23 @@ class PagedDecoder:
         (they would read garbage from a reset arena)."""
         cfg = self.cfg
         hd = cfg.d_model // cfg.n_heads
-        shape = (cfg.n_layers, self.n_blocks + 1, self.block_tokens,
-                 cfg.n_heads, hd)
-        # two distinct buffers: k and v donate separately and must not
-        # alias each other; the scatter in paged_decode_step casts k/v
-        # onto ck.dtype, so a bf16 arena under an f32 model just works
-        self._arena = {"k": jnp.zeros(shape, self.kv_dtype),
-                       "v": jnp.zeros(shape, self.kv_dtype)}
+        self._arena = self._zero_arena()
         self._blocks = BlockArena(self.n_blocks)
         self._prefix = PrefixCache(self._blocks)
         self.stats.set_kv_blocks(0, self.n_blocks)
+
+    def _zero_arena(self):
+        """Fresh zeroed k/v buffers (factored so the mesh subclass can
+        place them sharded). Two distinct buffers: k and v donate
+        separately and must not alias each other; the scatter in
+        paged_decode_step casts k/v onto ck.dtype, so a bf16 arena under
+        an f32 model just works."""
+        cfg = self.cfg
+        hd = cfg.d_model // cfg.n_heads
+        shape = (cfg.n_layers, self.n_blocks + 1, self.block_tokens,
+                 cfg.n_heads, hd)
+        return {"k": jnp.zeros(shape, self.kv_dtype),
+                "v": jnp.zeros(shape, self.kv_dtype)}
 
     # -- capacity ---------------------------------------------------------
     def kv_capacity(self) -> Dict[str, object]:
@@ -563,6 +648,7 @@ class PagedDecoder:
             "tokens_in_use": tokens_in_use,
             "lanes": self.lanes,
             "prefix_blocks_cached": len(self._prefix),
+            "mesh_devices": int(self.mesh_devices),
         }
 
     # -- client side ------------------------------------------------------
@@ -823,9 +909,155 @@ class PagedDecoder:
         # the lane index rides the signature so subclasses with per-lane
         # side state (serving/speculate.py prefills its draft cache row
         # here) share this crash-isolation boundary
-        self._arena = _paged_admit_for(self.cfg, width, self.block_tokens)(
-            self.lm.params, self._arena, jnp.asarray(buf),
+        self._arena = self._build_admit(width)(
+            self._infer_params, self._arena, jnp.asarray(buf),
             jnp.asarray(write_table))
+
+    # -- prefill/decode disaggregation ------------------------------------
+    def export_prefix(self, prompt, n_new: int):
+        """Prefill-role half of the handoff (ISSUE 18): compute the
+        primed KV for a prompt's FULL blocks strictly below the write
+        block, plus their digest chain, without touching the arena or
+        the worker. The digests are the same chained sha256 the decode
+        replica's own admission computes (PrefixCache.chain_hashes over
+        the re-based window), so the handoff is content-addressed: the
+        importer adopts the blocks as ordinary prefix-cache entries and
+        a later admission of the same window hits them — or, on any
+        miss, recomputes them byte-identically (the prefix-cache
+        byte-stability argument). Returns (digests, k_blocks, v_blocks)
+        with blocks [L, n, bt, H, hd] in the arena dtype; n may be 0
+        for short prompts (nothing worth handing off)."""
+        cfg = self.cfg
+        bt = self.block_tokens
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        n_new = int(n_new)
+        if n_new < 1 or n_new >= cfg.max_len:
+            raise ValueError(f"n_new {n_new} must be in [1, max_len)")
+        keep = min(prompt.size, cfg.max_len - n_new)
+        window = np.ascontiguousarray(prompt[prompt.size - keep:])
+        wb0 = (keep - 1) // bt
+        digests = PrefixCache.chain_hashes(window, bt, wb0)
+        hd = cfg.d_model // cfg.n_heads
+        if wb0 == 0:
+            z = np.zeros((cfg.n_layers, 0, bt, cfg.n_heads, hd),
+                         self.kv_dtype)
+            return [], z, z.copy()
+        width = min(max(dispatch.bucket_size(keep), keep), cfg.max_len)
+        buf = np.zeros((1, width), np.int32)
+        buf[0, :keep] = window
+        kb, vb = _prefix_export_for(cfg, width, bt, self.kv_dtype)(
+            self._infer_params, jnp.asarray(buf))
+        self.stats.record_prefix_export()
+        return (digests,
+                np.asarray(kb[:, :wb0]), np.asarray(vb[:, :wb0]))
+
+    def import_prefix(self, digests, k_blocks, v_blocks,
+                      timeout_s: float = 60.0) -> int:
+        """Decode-role half of the handoff: queue handed-off prompt
+        blocks for adoption into the arena + prefix cache. The worker
+        owns the donated arena, so the scatter runs on its thread
+        between ticks. Returns how many blocks were actually adopted;
+        correctness never depends on it — an already-cached digest, an
+        exhausted free list or a device failure just shrink the adopted
+        run, and the next admission's prefill recomputes the rest."""
+        cfg = self.cfg
+        hd = cfg.d_model // cfg.n_heads
+        digests = list(digests)
+        kb = np.asarray(k_blocks)
+        vb = np.asarray(v_blocks)
+        expect = (cfg.n_layers, len(digests), self.block_tokens,
+                  cfg.n_heads, hd)
+        if kb.shape != expect or vb.shape != expect:
+            raise ClientRequestError(
+                f"prefix blocks {kb.shape}/{vb.shape} do not match the "
+                f"arena layout {expect}")
+        if kb.dtype != self.kv_dtype or vb.dtype != self.kv_dtype:
+            raise ClientRequestError(
+                f"prefix blocks dtype {kb.dtype}/{vb.dtype} != arena kv "
+                f"dtype {self.kv_dtype} (mismatched "
+                "DL4J_TPU_SERVE_KV_DTYPE across roles)")
+        if len(digests) >= self.table_width:
+            raise ClientRequestError(
+                f"{len(digests)} handed-off blocks >= table width "
+                f"{self.table_width}; full blocks strictly below the "
+                "write block can never reach it")
+        if not digests:
+            return 0
+        fut = Future()
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("decoder is stopped")
+            if self._dead is not None:
+                raise WorkerDeadError(
+                    f"decoder worker died ({self._dead}); imports would "
+                    "queue forever")
+            self._imports.append((digests, kb, vb, fut))
+            self._cond.notify_all()
+        return int(fut.result(timeout=timeout_s))
+
+    def _apply_import(self, digests, kb, vb, fut) -> None:
+        """Adopt handed-off prefix blocks (worker thread; the donated
+        scatter shares the admission crash-isolation discipline)."""
+        try:
+            with self._cond:
+                hits = self._prefix.lookup(digests)
+                start = len(hits)
+                need = len(digests) - start
+                if need and self._blocks.free_count < need:
+                    self._prefix.reclaim(need - self._blocks.free_count)
+                avail = min(need, self._blocks.free_count)
+                fresh = [self._blocks.alloc() for _ in range(avail)]
+                self.stats.set_kv_blocks(self._blocks.in_use,
+                                         self.n_blocks)
+            if not fresh:
+                fut.set_result(0)
+                return
+            cfg = self.cfg
+            hd = cfg.d_model // cfg.n_heads
+            table = np.zeros((self.table_width,), np.int32)
+            kpad = np.zeros((cfg.n_layers, self.table_width,
+                             self.block_tokens, cfg.n_heads, hd),
+                            self.kv_dtype)
+            vpad = np.zeros_like(kpad)
+            for t, j in enumerate(range(start, start + avail)):
+                table[j] = fresh[t]
+                kpad[:, j] = kb[:, j]
+                vpad[:, j] = vb[:, j]
+            try:
+                self._arena = self._build_import()(
+                    self._arena, jnp.asarray(kpad), jnp.asarray(vpad),
+                    jnp.asarray(table))
+            except Exception as e:  # noqa: BLE001 — device boundary
+                with self._cond:
+                    for b in fresh:
+                        self._blocks.decref(b)
+                try:
+                    deleted = self._arena["k"].is_deleted()
+                except Exception:  # noqa: BLE001 — probe only
+                    deleted = False
+                if deleted:
+                    # the DONATED import died mid-execution and took the
+                    # arena with it (same honesty as a crashed admit)
+                    self._fail_active_lanes(e)
+                fut.set_exception(e)
+                return
+            with self._cond:
+                for t, j in enumerate(range(start, start + avail)):
+                    self._prefix.insert(digests[j], fresh[t])
+                    # the cache's ref is the only owner (alloc's ref was
+                    # the import's working hold); a concurrent admission
+                    # that beat us to the digest makes insert a no-op
+                    # and this decref frees our duplicate block
+                    self._blocks.decref(fresh[t])
+                self.stats.set_kv_blocks(self._blocks.in_use,
+                                         self.n_blocks)
+                self.stats.record_prefix_import(avail)
+            fut.set_result(avail)
+        except Exception as e:  # noqa: BLE001 — import isolation boundary
+            if not fut.done():
+                fut.set_exception(e)
 
     # -- worker side ------------------------------------------------------
     def _run(self) -> None:
@@ -840,8 +1072,14 @@ class PagedDecoder:
                 for q in self._pending.values():
                     victims.extend(q)
                     q.clear()
+                imports = list(self._imports)
+                self._imports.clear()
                 self.stats.set_queue_depth(0, "decode")
                 self._cond.notify_all()
+            for item in imports:
+                if not item[3].done():
+                    item[3].set_exception(WorkerDeadError(
+                        f"decoder worker died: {self._dead}"))
             self.stats.record_worker_death()
             err = WorkerDeadError(f"decoder worker died: {self._dead}")
             for v in victims:
@@ -885,6 +1123,16 @@ class PagedDecoder:
                         else:
                             alive.append(req)
                     self._pending[name] = alive
+            # adopt handed-off prefix blocks BEFORE admissions so a
+            # request admitted in this same pass hits them (the
+            # prefill/decode disaggregation import path)
+            while True:
+                with self._cond:
+                    item = self._imports.popleft() if self._imports \
+                        else None
+                if item is None:
+                    break
+                self._apply_import(*item)
             # admission: ONE request per pick so a request admitted
             # later in the same pass can hit the prefix blocks an
             # earlier prefill just cached — inserts land between
@@ -979,7 +1227,7 @@ class PagedDecoder:
             with obs_trace.span("serve.batch", kind="decode.paged",
                                 lanes=len(active), tick_k=k):
                 self._arena, nxt, keys = self._tick_fn(k)(
-                    self.lm.params, self._arena,
+                    self._infer_params, self._arena,
                     jnp.asarray(self._tok), jnp.asarray(self._pos),
                     jnp.asarray(self._tables),
                     jnp.asarray(self._keys),

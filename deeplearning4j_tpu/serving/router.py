@@ -107,23 +107,34 @@ def _addr_path(root: str, replica_id: str) -> str:
     return os.path.join(root, f"replica-{replica_id}.addr")
 
 
-def publish_replica_addr(root: str, replica_id: str, url: str) -> None:
+def publish_replica_addr(root: str, replica_id: str, url: str,
+                         role: str = "") -> None:
     """Atomic addr publish (tmp + os.replace — the board's own idiom): a
     router reading mid-write must see the old addr or the new one, never
-    half a JSON."""
+    half a JSON. ``role`` is the prefill/decode disaggregation tag
+    (ISSUE 18; '' serves both planes) — routing METADATA beside the
+    addr, so the router learns the split from the same membership read."""
     path = _addr_path(root, replica_id)
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump({"url": url, "pid": os.getpid()}, f)
+        json.dump({"url": url, "pid": os.getpid(), "role": str(role)}, f)
     os.replace(tmp, path)
 
 
-def read_replica_addr(root: str, replica_id: str) -> Optional[str]:
+def read_replica_entry(root: str, replica_id: str) -> Optional[Dict[str, str]]:
+    """The published addr record: {"url": ..., "role": ...}. Addr files
+    written before the role field existed read as role '' (both planes)."""
     try:
         with open(_addr_path(root, replica_id), encoding="utf-8") as f:
-            return str(json.load(f)["url"])
+            data = json.load(f)
+        return {"url": str(data["url"]), "role": str(data.get("role", ""))}
     except (OSError, ValueError, KeyError):
         return None  # not published yet (join race) or mid-removal
+
+
+def read_replica_addr(root: str, replica_id: str) -> Optional[str]:
+    entry = read_replica_entry(root, replica_id)
+    return entry["url"] if entry is not None else None
 
 
 def remove_replica_addr(root: str, replica_id: str) -> None:
@@ -157,6 +168,11 @@ class RouterStats:
         self.replicas_left = 0
         self.rollouts = 0            # completed rolling rollouts
         self.rollbacks = 0           # rollouts auto-rolled back
+        # prefill/decode disaggregation (ISSUE 18): /generate requests
+        # whose prompt prefill ran on a prefill-role replica vs those
+        # that fell back to the direct decode path (best-effort handoff)
+        self.prefill_handoffs = 0
+        self.prefill_fallbacks = 0
         # replica-breaker plane (CircuitBreaker stats hooks)
         self.breaker_opens = 0       # replicas ejected
         self.breaker_closes = 0      # half-open probes that re-admitted
@@ -212,6 +228,14 @@ class RouterStats:
             else:
                 self.rollouts += 1
 
+    def record_prefill_handoff(self) -> None:
+        with self._lock:
+            self.prefill_handoffs += 1
+
+    def record_prefill_fallback(self) -> None:
+        with self._lock:
+            self.prefill_fallbacks += 1
+
     # -- CircuitBreaker stats-sink surface --------------------------------
     def record_breaker_open(self) -> None:
         with self._lock:
@@ -259,6 +283,8 @@ class RouterStats:
                 "replicas_left": self.replicas_left,
                 "rollouts": self.rollouts,
                 "rollbacks": self.rollbacks,
+                "prefill_handoffs": self.prefill_handoffs,
+                "prefill_fallbacks": self.prefill_fallbacks,
                 "breaker_opens": self.breaker_opens,
                 "breaker_closes": self.breaker_closes,
                 "breaker_probes": self.breaker_probes,
@@ -272,14 +298,16 @@ class _Replica:
     """Router-side view of one replica: address, readiness verdict from
     the poll, and the replica-level breaker fed by the request path."""
 
-    def __init__(self, rid: str, url: str, breaker: CircuitBreaker):
+    def __init__(self, rid: str, url: str, breaker: CircuitBreaker,
+                 role: str = ""):
         self.rid = rid
         self.url = url
         self.breaker = breaker
+        self.role = str(role)  # '' both planes | 'prefill' | 'decode'
         self.ready = True  # optimistic until the first probe says no
 
     def describe(self) -> Dict[str, Any]:
-        return {"url": self.url, "ready": self.ready,
+        return {"url": self.url, "ready": self.ready, "role": self.role,
                 "breaker": self.breaker.snapshot()}
 
 
@@ -366,7 +394,13 @@ class FleetRouter:
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
         for rid, url in sorted((replicas or {}).items()):
-            self._add_replica(rid, url)
+            # a static entry is a url string, or {"url":..., "role":...}
+            # for role-tagged board-less tests
+            if isinstance(url, dict):
+                self._add_replica(rid, url["url"],
+                                  role=url.get("role", ""))
+            else:
+                self._add_replica(rid, url)
         router_port = int(port if port is not None else router_port_default())
         self._httpd = ThreadingHTTPServer(("127.0.0.1", router_port),
                                           self._make_handler())
@@ -378,7 +412,7 @@ class FleetRouter:
         return f"http://127.0.0.1:{self.port}"
 
     # -- membership + readiness (poll thread) -----------------------------
-    def _add_replica(self, rid: str, url: str) -> None:
+    def _add_replica(self, rid: str, url: str, role: str = "") -> None:
         def on_transition(old, new, reason, _rid=rid):
             obs_journal.event("fleet.replica_health", replica=_rid,
                               old=old, new=new, reason=reason)
@@ -388,9 +422,10 @@ class FleetRouter:
             key=f"replica:{rid}", stats=self.stats,
             on_transition=on_transition)
         with self._lock:
-            self._replicas[rid] = _Replica(rid, url, breaker)
+            self._replicas[rid] = _Replica(rid, url, breaker, role=role)
         self.stats.record_join()
-        obs_journal.event("fleet.replica_join", replica=rid, url=url)
+        obs_journal.event("fleet.replica_join", replica=rid, url=url,
+                          role=role)
 
     def _remove_replica(self, rid: str) -> None:
         with self._lock:
@@ -415,9 +450,10 @@ class FleetRouter:
                 with self._lock:
                     known = set(self._replicas)
                 for rid in sorted(live - known):
-                    url = read_replica_addr(self.fleet_dir, rid)
-                    if url is not None:  # addr lags the heartbeat briefly
-                        self._add_replica(rid, url)
+                    entry = read_replica_entry(self.fleet_dir, rid)
+                    if entry is not None:  # addr lags the heartbeat briefly
+                        self._add_replica(rid, entry["url"],
+                                          role=entry["role"])
                 for rid in sorted(known - live):
                     self._remove_replica(rid)
                 # a restarted replica re-publishes its addr (new port)
@@ -427,15 +463,16 @@ class FleetRouter:
                 # routable as soon as it probes ready, instead of
                 # waiting broken for request traffic to half-open it
                 for rid in sorted(live & known):
-                    url = read_replica_addr(self.fleet_dir, rid)
-                    if url is None:
+                    entry = read_replica_entry(self.fleet_dir, rid)
+                    if entry is None:
                         continue
                     with self._lock:
                         rep = self._replicas.get(rid)
-                        changed = rep is not None and rep.url != url
+                        changed = rep is not None and rep.url != entry["url"]
                     if changed:
                         self._remove_replica(rid)
-                        self._add_replica(rid, url)
+                        self._add_replica(rid, entry["url"],
+                                          role=entry["role"])
         for rep in self._snapshot():
             self._probe_ready(rep)
 
@@ -503,8 +540,18 @@ class FleetRouter:
             self._inflight -= 1
 
     # -- routing -----------------------------------------------------------
-    def _candidates(self) -> List[_Replica]:
+    def _candidates(self, decode_only: bool = False) -> List[_Replica]:
         reps = self._snapshot()
+        if decode_only:
+            # role-aware /generate dispatch (ISSUE 18): a prefill-role
+            # replica exists to run /prefill, not to hold decode lanes —
+            # route decode traffic away from it. Availability beats the
+            # split: when ONLY prefill replicas survive they still
+            # answer /generate (the role declares intent, the engine
+            # serves everything).
+            decode = [r for r in reps if r.role != "prefill"]
+            if decode:
+                reps = decode
         ready = []
         for rep in reps:
             if rep.ready:
@@ -599,6 +646,79 @@ class FleetRouter:
         raise FleetRouterError("no routable replica (all not-ready, "
                                "ejected, or failed)")
 
+    # -- prefill/decode disaggregation (ISSUE 18) --------------------------
+    def _prefill_payload(self, body: bytes) -> Optional[bytes]:
+        """When a prefill-role replica is routable, run the prompt
+        prefill THERE (/prefill) and return the /prime payload the
+        chosen decode replica adopts before /generate. Best-effort BY
+        CONSTRUCTION: every failure path returns None and the decode
+        replica recomputes the same bytes itself — the handoff changes
+        where the prefill dispatch runs, never what the client reads
+        (byte-identical either way, tests/test_serving_mesh.py)."""
+        payload = _parse_json(body)
+        toks = payload.get("tokens")
+        if not toks:
+            return None
+        pre_all = [rep for rep in self._snapshot()
+                   if rep.role == "prefill"]
+        if not pre_all:
+            return None  # no prefill plane deployed: not a fallback
+        # a DEPLOYED prefill plane with no ready member IS a fallback —
+        # the loop below is empty and falls through to the counter
+        pre = [rep for rep in pre_all if rep.ready]
+        req = json.dumps({
+            "model": payload.get("model"),
+            "version": payload.get("version"),
+            "tokens": toks,
+            "n_new": int(payload.get("n_new", 16)),
+        }).encode()
+        for rep in pre:
+            try:
+                rep.breaker.check()
+            except BreakerOpenError:
+                continue
+            try:
+                status, _, data = self._proxy_once(rep, "POST",
+                                                   "/prefill", req)
+            except OSError as e:
+                self.stats.record_replica_failure()
+                rep.breaker.record_failure(f"{type(e).__name__}: {e}")
+                continue
+            if status != 200:
+                if status >= 500:
+                    rep.breaker.record_failure(f"HTTP {status}")
+                break  # an answered refusal: fall back to direct decode
+            rep.breaker.record_success()
+            out = _parse_json(data)
+            if not out.get("digests"):
+                # prompt shorter than one full block: nothing to hand
+                # off — the direct path IS the whole computation
+                return None
+            self.stats.record_prefill_handoff()
+            return json.dumps({
+                "model": payload.get("model"),
+                "version": payload.get("version"),
+                "digests": out["digests"],
+                "k": out["k"], "v": out["v"],
+                "shape": out["shape"], "dtype": out["dtype"],
+            }).encode()
+        self.stats.record_prefill_fallback()
+        return None
+
+    def _prime_replica(self, rep: _Replica, prime: Optional[bytes]) -> None:
+        """Best-effort /prime of the chosen decode replica with the
+        handed-off blocks. NO breaker vote and failures are swallowed:
+        the /generate that follows is both the real health evidence and
+        the correctness fallback (a missed adoption only costs the
+        recompute)."""
+        if prime is None:
+            return
+        try:
+            _http_call(rep.url, "POST", "/prime", body=prime,
+                       timeout=self.request_timeout_s)
+        except OSError:
+            pass
+
     def proxy_generate(self, body: bytes) -> tuple:
         """Route one /generate: same candidate walk, but retry ONLY on a
         connect-phase failure (no bytes exchanged — sampling must never
@@ -616,7 +736,8 @@ class FleetRouter:
 
     def _walk_generate(self, body: bytes) -> tuple:
         last_response: Optional[tuple] = None
-        for rep in self._candidates():
+        prime = self._prefill_payload(body)
+        for rep in self._candidates(decode_only=True):
             try:
                 rep.breaker.check()
             except BreakerOpenError:
@@ -628,6 +749,7 @@ class FleetRouter:
                     self.stats.record_replica_failure()
                     rep.breaker.record_failure(f"{type(e).__name__}: {e}")
                     continue
+            self._prime_replica(rep, prime)
             u = urlsplit(rep.url)
             conn = http.client.HTTPConnection(
                 u.hostname, u.port, timeout=self.request_timeout_s)
@@ -911,7 +1033,8 @@ class FleetRouter:
         """Proxy a streaming /generate to the first replica that ACCEPTS
         it (connect + response headers); after that the stream is
         committed (a half-relayed token stream cannot be replayed)."""
-        for rep in self._candidates():
+        prime = self._prefill_payload(body)
+        for rep in self._candidates(decode_only=True):
             try:
                 rep.breaker.check()
             except BreakerOpenError:
@@ -923,6 +1046,7 @@ class FleetRouter:
                     self.stats.record_replica_failure()
                     rep.breaker.record_failure(f"{type(e).__name__}: {e}")
                     continue
+            self._prime_replica(rep, prime)
             u = urlsplit(rep.url)
             conn = http.client.HTTPConnection(
                 u.hostname, u.port, timeout=self.request_timeout_s)

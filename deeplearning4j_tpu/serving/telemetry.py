@@ -73,6 +73,11 @@ class ServingStats:
         self.prefix_lookups = 0    # prompt blocks consulted in the cache
         self.prefix_hits = 0       # prompt blocks served from the cache
         self.preemptions = 0       # lanes evicted-and-requeued (OOB arena)
+        # -- prefill/decode disaggregation (serving/mesh role handoff):
+        # exported prefill dispatches and blocks adopted sight-unseen
+        self.prefix_exports = 0        # /prefill export dispatches run
+        self.prefix_imports = 0        # /prime adoptions applied
+        self.prefix_import_blocks = 0  # blocks adopted across adoptions
         # -- speculative decode (serving/speculate.py): draft-k-then-
         # verify accounting — acceptance_rate (accepted/proposed) is the
         # number the draft model's cost trade is judged by
@@ -182,6 +187,15 @@ class ServingStats:
         with self._lock:
             self.preemptions += 1
 
+    def record_prefix_export(self) -> None:
+        with self._lock:
+            self.prefix_exports += 1
+
+    def record_prefix_import(self, blocks: int) -> None:
+        with self._lock:
+            self.prefix_imports += 1
+            self.prefix_import_blocks += int(blocks)
+
     def record_draft(self, proposed: int, accepted: int) -> None:
         """One speculative round's verdict: ``proposed`` draft tokens
         scored by the target, of which ``accepted`` matched the target's
@@ -253,6 +267,9 @@ class ServingStats:
                 "prefix_lookups": self.prefix_lookups,
                 "prefix_hits": self.prefix_hits,
                 "preemptions": self.preemptions,
+                "prefix_exports": self.prefix_exports,
+                "prefix_imports": self.prefix_imports,
+                "prefix_import_blocks": self.prefix_import_blocks,
                 "draft_proposed": self.draft_proposed,
                 "draft_accepted": self.draft_accepted,
                 "draft_rejected": self.draft_rejected,

@@ -33,7 +33,7 @@ EXPECTED = [
     "resnet50", "resnet50_bf16", "transformer_lm_big", "flash_attention",
     "ring_attention", "lstm_kernel", "north_star", "serving_throughput",
     "serving_resilience", "serving_decode", "serving_fleet",
-    "decode_amortize", "checkpoint_overhead",
+    "decode_amortize", "serving_mesh", "checkpoint_overhead",
     "input_pipeline",
     "elastic_dp", "online_loop", "lowprec", "retrieval", "obs_overhead",
     "paged_kernel", "sgns_kernel",

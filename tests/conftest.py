@@ -124,6 +124,13 @@ _QUICK_FILES = {
     # MEASURED IVF recall, zero-failed-/search across a generation swap,
     # drift veto, knob/ledger registration — tiny nets, ~20s
     "test_retrieval.py",
+    # mesh-sharded inference plane (ISSUE 18): sharded tick == solo tick
+    # byte-identity across the paged contract matrix (prefix sharing /
+    # preemption / crash eviction / streaming), loud incompatibility
+    # gates, per-device arena closed forms, role-aware router dispatch +
+    # the prefill->decode handoff, knob/ledger registration — tiny LMs
+    # on the virtual CPU mesh, ~40s
+    "test_serving_mesh.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
