@@ -1605,6 +1605,179 @@ def bench_serving_fleet(clients=8, per_client=12):
 
 
 # ---------------------------------------------------------------------------
+# autoscale: the ISSUE 20 control loop — scripted load wave -> scale-up
+# (time-to-scale measured wave start -> second replica ready), quiet
+# ticks -> scale-down draining the victim through the goodbye path while
+# live /predict traffic keeps flowing (zero failed admitted requests),
+# deterministic decision replay from the recorded signals_log, and the
+# per-tenant token-bucket fairness proof (one tenant's burst sheds 429
+# while the other's admission is untouched). CPU-only by design: every
+# measured quantity is host-side control-plane work.
+# ---------------------------------------------------------------------------
+
+_AUTOSCALE_SCRIPT = r"""
+import json, sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import urllib.error, urllib.request
+import numpy as np
+from deeplearning4j_tpu.nn.conf import (DenseLayer, NeuralNetConfiguration,
+                                        OutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.resilience import (AutoscaleChaos,
+                                           AutoscaleChaosConfig)
+from deeplearning4j_tpu.serving.autoscale import FleetAutoscaler, ScaleConfig
+from deeplearning4j_tpu.serving.fleet import ServingFleet
+from deeplearning4j_tpu.serving.placement import model_footprint
+from deeplearning4j_tpu.serving.registry import bucket_ladder
+from deeplearning4j_tpu.serving.router import read_replica_addr
+
+hammers, burst_n = int(sys.argv[1]), int(sys.argv[2])
+N_IN = 64
+conf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.01)
+        .updater("adam").list()
+        .layer(0, DenseLayer(n_in=N_IN, n_out=64, activation="relu"))
+        .layer(1, OutputLayer(n_in=64, n_out=8, activation="softmax",
+                              loss_function="mcxent"))
+        .build())
+net = MultiLayerNetwork(conf).init()
+for b in sorted(set(bucket_ladder(64)) | {1}):
+    np.asarray(net.output(np.zeros((b, N_IN), np.float32)))
+
+fleet = ServingFleet(model=net, replicas=1, heartbeat_s=0.5,
+                     router_kwargs={
+                         "tenant_quotas": "burst:0.001:3,steady:1e9:1e9"})
+fleet.start()
+cfg = ScaleConfig(min_replicas=1, max_replicas=2, up_queue=10.0,
+                  up_shed=0, window=2, down_queue=2.0, cooldown=1)
+auto = FleetAutoscaler(fleet, config=cfg, chaos=AutoscaleChaos(
+    AutoscaleChaosConfig(load_wave={"at_tick": 0, "ticks": 2,
+                                    "queue_depth": 50})))
+plan = auto.plan_placement([model_footprint("default", net)])
+
+
+def wait_ready(n, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if len(fleet.router.signals()["ready_replicas"]) >= n:
+            return
+        time.sleep(0.05)
+    raise RuntimeError("fleet never reached %d ready replicas" % n)
+
+
+def post(payload, timeout=60):
+    req = urllib.request.Request(
+        fleet.url + "/predict", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except OSError:
+        return -1
+
+
+wait_ready(1)
+row = [[0.1] * N_IN]
+codes, lock, stop = [], threading.Lock(), threading.Event()
+
+
+def hammer():
+    while not stop.is_set():
+        c = post({"batch": row})
+        with lock:
+            codes.append(c)
+        time.sleep(0.004)
+
+
+threads = [threading.Thread(target=hammer) for _ in range(hammers)]
+for t in threads:
+    t.start()
+t_wave = time.perf_counter()
+while auto.tick()["action"] != "up":
+    time.sleep(0.02)
+wait_ready(2)
+time_to_scale = time.perf_counter() - t_wave
+t_down_start = time.perf_counter()
+down = None
+for _ in range(30):
+    d = auto.tick()
+    if d["action"] == "down":
+        down = d
+        break
+    time.sleep(0.05)
+assert down is not None and down.get("enacted") == down["victim"]
+time_to_drain = time.perf_counter() - t_down_start
+time.sleep(0.3)  # a last window of traffic on the survivor
+stop.set()
+for t in threads:
+    t.join(timeout=30)
+failed = sum(1 for c in codes if c != 200)
+stale_addr = read_replica_addr(fleet.fleet_dir, down["victim"]) is not None
+
+replay = FleetAutoscaler.replay(auto.signals_log, config=cfg)
+stripped = [{k: v for k, v in d.items()
+             if k not in ("enacted", "enact_error")}
+            for d in auto.decisions]
+replay_match = stripped == replay
+
+tenant_codes = {"burst": [], "steady": []}
+for i in range(burst_n):
+    tenant_codes["burst"].append(post({"batch": row, "tenant": "burst"}))
+    tenant_codes["steady"].append(post({"batch": row, "tenant": "steady"}))
+tsnap = fleet.router.stats.snapshot()
+fleet.stop()
+
+snap = auto.stats.snapshot()
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "device": str(jax.devices()[0]),
+    "data": "synthetic",
+    "decisions": [d["action"] for d in auto.decisions],
+    "time_to_scale_s": round(time_to_scale, 3),
+    "time_to_drain_s": round(time_to_drain, 3),
+    "scale_down": {"requests": len(codes), "failed": failed,
+                   "victim": down["victim"],
+                   "stale_addr_left": stale_addr},
+    "replay_match": replay_match,
+    "tenant": {"admitted": tsnap["tenant_admitted"],
+               "shed": tsnap["tenant_shed"],
+               "burst_429": sum(1 for c in tenant_codes["burst"]
+                                if c == 429),
+               "steady_429": sum(1 for c in tenant_codes["steady"]
+                                 if c == 429)},
+    "placement": {"models": plan.models(), "unplaced": plan.unplaced,
+                  "utilization": plan.describe()["utilization"]},
+    "autoscale_stats": snap,
+    "stat": "scripted load wave -> scale-up (wave start -> second "
+            "replica ready) -> quiet -> scale-down draining the victim "
+            "under live /predict traffic; failed counts every non-200 "
+            "answer an admitted client saw; replay_match re-runs the "
+            "decision layer over the recorded signals_log",
+    "note": "1-core host, CPU-only by design: every measured quantity "
+            "is host-side control-plane work (decisions, drain, "
+            "routing), identical on every backend",
+}))
+"""
+
+
+def bench_autoscale(hammers=3, burst_n=10):
+    """Autoscaling control-plane leg (ISSUE 20 — serving/autoscale.py):
+    scripted load wave -> scale-up time, zero-loss scale-down under
+    live traffic, bit-exact decision replay, tenant-bucket fairness.
+    Subprocess-isolated, CPU-only by design (host-side control plane)."""
+    parsed, err = _run_subprocess_json(
+        [sys.executable, "-c", _AUTOSCALE_SCRIPT, str(hammers),
+         str(burst_n)], 900)
+    if parsed is None:
+        return {"error": err}
+    return parsed
+
+
+# ---------------------------------------------------------------------------
 # serving_decode: paged block-pool /generate vs the fixed slot pool at
 # EQUAL KV HBM budget (ISSUE 11 — serving/paged.py). CPU-only by design:
 # the contested resource is KV capacity and the win is scheduling
@@ -3640,7 +3813,8 @@ _CPU_ONLY_LEGS = {"reference_cpu_lenet5_torch", "scaling_virtual8",
                   "lenet5_cpu", "char_rnn_cpu",
                   "remat_memory", "input_pipeline", "elastic_dp",
                   "obs_overhead", "paged_kernel", "sgns_kernel",
-                  "online_loop", "lowprec", "retrieval", "serving_mesh"}
+                  "online_loop", "lowprec", "retrieval", "serving_mesh",
+                  "autoscale"}
 
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_PARTIAL.json")
@@ -3837,7 +4011,7 @@ def main():
             elif name in ("scaling_virtual8", "north_star", "lstm_kernel",
                           "dispatch_overhead", "serving_throughput",
                           "serving_resilience", "serving_decode",
-                          "serving_fleet", "decode_amortize",
+                          "serving_fleet", "autoscale", "decode_amortize",
                           "checkpoint_overhead",
                           "lenet5_cpu", "char_rnn_cpu", "remat_memory",
                           "input_pipeline", "elastic_dp", "obs_overhead",
@@ -3911,6 +4085,8 @@ def main():
         per_client=4 if quick else 8)
     run("serving_fleet", bench_serving_fleet,
         per_client=4 if quick else 12)
+    run("autoscale", bench_autoscale,
+        hammers=2 if quick else 3, burst_n=6 if quick else 10)
     run("checkpoint_overhead", bench_checkpoint_overhead,
         steps=12 if quick else 30)
     run("input_pipeline", bench_input_pipeline,

@@ -231,6 +231,33 @@ _register("DL4J_TPU_SERVE_ROUTER_PORT", "0", "int",
 _register("DL4J_TPU_SERVE_REPLICA_FAILS", "3", "int",
           "consecutive connect/5xx failures that eject a replica from "
           "the router (0 disables replica breakers)")
+_register("DL4J_TPU_SERVE_SCALE_MIN", "1", "int",
+          "autoscaler floor: never scale the fleet below this many "
+          "replicas")
+_register("DL4J_TPU_SERVE_SCALE_MAX", "4", "int",
+          "autoscaler ceiling: never scale the fleet above this many "
+          "replicas")
+_register("DL4J_TPU_SERVE_SCALE_UP_QUEUE", "8", "float",
+          "scale-up pressure: mean queued requests per ready replica "
+          "at or above this votes up for the tick")
+_register("DL4J_TPU_SERVE_SCALE_UP_P99_FRAC", "0.8", "float",
+          "scale-up pressure: a class p99 at or above this fraction of "
+          "its SLO deadline votes up for the tick")
+_register("DL4J_TPU_SERVE_SCALE_UP_SHED", "1", "int",
+          "scale-up pressure: at least this many new router sheds "
+          "since the previous tick votes up (0 disables the shed vote)")
+_register("DL4J_TPU_SERVE_SCALE_WINDOW", "3", "int",
+          "consecutive ticks of one-sided pressure before the "
+          "autoscaler acts (the sustained-evidence window)")
+_register("DL4J_TPU_SERVE_SCALE_DOWN_QUEUE", "0", "float",
+          "scale-down pressure: mean queued requests per ready replica "
+          "at or below this (with zero sheds) votes down for the tick")
+_register("DL4J_TPU_SERVE_SCALE_COOLDOWN", "5", "int",
+          "ticks after any scale action before the next one (counted "
+          "in TICKS, not wall-clock, so decisions replay bit-exact)")
+_register("DL4J_TPU_SERVE_TENANT_QUOTAS", "", "str",
+          "per-tenant token-bucket quotas 'name:rate_per_s[:burst],...'"
+          " ('' = no tenant metering; unlisted tenants are unmetered)")
 
 # resilience / checkpointing (resilience/)
 _register("DL4J_TPU_CKPT_EVERY", "0", "int",

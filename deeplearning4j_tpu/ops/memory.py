@@ -415,3 +415,28 @@ def ann_arena_rows(dim: int, *, params=None,
     per_row = 3 * ann_row_bytes(dim, dtype)
     rows = int(max(0.0, budget) * float(ann_fraction) / per_row)
     return max(int(min_rows), min(int(max_rows), rows))
+
+
+# ---------------------------------------------------------------------------
+# model resident-bytes pricing (the placement plane's bin-packing input)
+# ---------------------------------------------------------------------------
+
+# the same buffer attrs the serving registry walks when it deletes a
+# retired model's device buffers (serving/registry._delete_device_buffers)
+# — what unload frees is exactly what residency must price
+MODEL_BUFFER_ATTRS = ("params", "states", "updater_state", "opt")
+
+
+def model_resident_bytes(model) -> int:
+    """Device bytes a loaded model keeps RESIDENT: its params /
+    batch-norm states / updater / optimizer pytrees, priced as pure
+    shape x itemsize arithmetic over the tree leaves — never a device
+    read, so it answers tunnel-free (the kv_arena_blocks discipline).
+    This is the per-model input to HBM bin-packing
+    (serving/placement.py) and the /replicas utilization report."""
+    total = 0
+    for attr in MODEL_BUFFER_ATTRS:
+        tree = getattr(model, attr, None)
+        if tree is not None:
+            total += _tree_bytes(tree)
+    return total

@@ -9,6 +9,8 @@ tests drive — never ambient).
 """
 
 from deeplearning4j_tpu.resilience.chaos import (  # noqa: F401
+    AutoscaleChaos,
+    AutoscaleChaosConfig,
     ChaosConfig,
     ChaosMonkey,
     CoordinatorPartitioned,

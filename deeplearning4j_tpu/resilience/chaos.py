@@ -576,6 +576,68 @@ class SpecChaos:
         return bad
 
 
+@dataclass
+class AutoscaleChaosConfig:
+    """Declarative load-wave plan for the autoscaler's decision loop
+    (serving/autoscale.py): overlay the SCRAPED /signals snapshot for a
+    scripted tick window so scale decisions can be forced and replayed
+    without generating real traffic. The chaos corrupts the DECISION
+    INPUT only — the autoscaler still decides, and the fleet's
+    spawn/depart hooks still enact (decide-vs-enact). Config-driven,
+    never ambient.
+
+      load_wave — {"at_tick": t, "ticks": n, "queue_depth": q[,
+                  "sheds_per_tick": s]}: ticks t..t+n-1 (1-based) see
+                  total queue depth q (and, optionally, s new router
+                  sheds per tick) in place of the measured values;
+                  outside the window the snapshot passes untouched.
+    """
+
+    load_wave: Optional[dict] = None
+
+    def __post_init__(self):
+        c = self.load_wave
+        if c is None:
+            return
+        if int(c.get("ticks", 1)) < 1:
+            raise ValueError("load_wave ticks must be >= 1")
+        if "queue_depth" not in c:
+            raise ValueError("load_wave needs queue_depth")
+
+
+class AutoscaleChaos:
+    """Stateful executor of an :class:`AutoscaleChaosConfig` (the
+    LowPrecChaos shape): :meth:`on_signals` returns the snapshot to
+    decide on — an overlaid COPY on wave ticks, the caller's dict
+    untouched. Deterministic: the same config over the same tick
+    sequence overlays the same values, so a replay of the recorded
+    post-overlay signal log reproduces the decision list bit-exact."""
+
+    def __init__(self, config: AutoscaleChaosConfig):
+        if isinstance(config, dict):
+            config = AutoscaleChaosConfig(**config)
+        self.config = config
+        self.log: list = []  # (tick, fault) audit trail for tests
+
+    def on_signals(self, tick: int, signals: dict) -> dict:
+        """``tick`` is the 1-based autoscaler tick about to decide."""
+        c = self.config.load_wave
+        if c is None:
+            return signals
+        at = int(c.get("at_tick", 1))
+        if not (at <= tick < at + int(c.get("ticks", 1))):
+            return signals
+        out = dict(signals)
+        out["queue_depth"] = int(c["queue_depth"])
+        sheds = int(c.get("sheds_per_tick", 0))
+        if sheds:
+            # cumulative: the decision loop votes on per-tick DELTAS
+            out["shed_total"] = (int(signals.get("shed_total", 0))
+                                 + sheds * (tick - at + 1))
+        self.log.append((tick, f"load_wave:q={out['queue_depth']}"))
+        return out
+
+
 def truncate_file(path: str, keep: int = 16) -> None:
     """Write-then-truncate fault: keep only the first `keep` bytes (a
     crash mid-write that an atomic rename would normally prevent —

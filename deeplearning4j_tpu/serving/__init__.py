@@ -59,6 +59,16 @@ package concentrates the counter-measures:
                 in-process engines or OS processes, each heartbeating
                 the membership board; SIGTERM -> engine drain ->
                 deregister goodbye; hard kill -> heartbeat expiry.
+  autoscale.py  FleetAutoscaler — the control loop over the fleet
+                (ISSUE 20): scrape /signals each tick, decide up/down/
+                hold from queue depth, per-class p99 vs deadline, and
+                shed-rate evidence (pure tick-counted decisions — a
+                recorded run replays bit-exact), enact through the
+                fleet's add_replica/depart_replica hooks.
+  placement.py  ModelFootprint/pack_models/PlacementPlan — HBM-aware
+                first-fit-decreasing model placement priced by the
+                ops/memory AOT accounting; the router's affinity filter
+                and /placement endpoint consume the plan.
 
 streaming/serving.py's ModelServer remains the compatibility surface: a
 thin subclass of ServingEngine with the original single-model contract.
@@ -80,7 +90,13 @@ from deeplearning4j_tpu.serving.resilience import (
     ModelWedgedError,
     WorkerDeadError,
 )
-from deeplearning4j_tpu.serving.slo import SLOClass, parse_slo_classes
+from deeplearning4j_tpu.serving.slo import (
+    SLOClass,
+    TenantBucket,
+    TenantQuota,
+    parse_slo_classes,
+    parse_tenant_quotas,
+)
 from deeplearning4j_tpu.serving.telemetry import ServingStats
 
 __all__ = [
@@ -90,20 +106,29 @@ __all__ = [
     "ContinuousDecoder",
     "DrainingError",
     "DynamicBatcher",
+    "FleetAutoscaler",
     "InferenceWatchdog",
     "FleetRouter",
+    "ModelFootprint",
     "ModelRegistry",
     "ModelWedgedError",
     "PagedDecoder",
+    "PlacementPlan",
     "RouterStats",
+    "ScaleConfig",
     "ServingFleet",
     "QueueFullError",
     "RequestTimeoutError",
     "SLOClass",
     "ServingEngine",
     "ServingStats",
+    "TenantBucket",
+    "TenantQuota",
     "WorkerDeadError",
+    "model_footprint",
+    "pack_models",
     "parse_slo_classes",
+    "parse_tenant_quotas",
 ]
 
 
@@ -130,4 +155,13 @@ def __getattr__(name):
         from deeplearning4j_tpu.serving.fleet import ServingFleet
 
         return ServingFleet
+    if name in ("FleetAutoscaler", "ScaleConfig"):
+        from deeplearning4j_tpu.serving import autoscale as _autoscale
+
+        return getattr(_autoscale, name)
+    if name in ("ModelFootprint", "PlacementPlan", "model_footprint",
+                "pack_models"):
+        from deeplearning4j_tpu.serving import placement as _placement
+
+        return getattr(_placement, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

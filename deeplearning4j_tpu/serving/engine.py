@@ -1225,11 +1225,74 @@ class ServingEngine:
                 out[rec.key] = decoder.kv_capacity()
         return out
 
+    def hbm_report(self) -> Dict[str, Any]:
+        """Per-replica HBM utilization (ISSUE 20 satellite): the
+        AOT-priced resident bytes — every non-broken record's buffer
+        pytrees (ops/memory.model_resident_bytes), every LIVE decoder's
+        KV arena (blocks x kv_block_bytes, incl. the trash block), and
+        every registered ANN store's arena — summed against the
+        ``DL4J_TPU_HBM_GB`` budget. Pure shape arithmetic, never a
+        device read, so /replicas reports it tunnel-free; it is also
+        the bin-packing input the autoscaler's placement plane prices
+        replicas with (serving/placement.py)."""
+        from deeplearning4j_tpu.ops import memory as opsmem
+
+        budget_bytes = int(opsmem.hbm_budget_gb() * 2.0**30)
+        models: Dict[str, Any] = {}
+        used = 0
+        with self._engine_lock:
+            decoders = dict(self._decoders)
+            stores = dict(self._indexes)
+        for d in self.registry.describe():
+            if d["state"] in ("broken", "unloaded"):
+                continue
+            rec = self.registry.get(d["name"], d["version"])
+            if rec is None or rec.model is None:
+                continue
+            entry = {"param_bytes": opsmem.model_resident_bytes(rec.model),
+                     "kv_bytes": 0}
+            decoder = decoders.get(rec.key)
+            cfg = getattr(decoder, "cfg", None)
+            if cfg is not None:
+                if hasattr(decoder, "n_blocks"):
+                    # paged arena: +1 is the trash block (serving/paged)
+                    entry["kv_bytes"] = (
+                        (decoder.n_blocks + 1) * opsmem.kv_block_bytes(
+                            cfg, decoder.block_tokens,
+                            getattr(decoder, "kv_dtype", None),
+                            devices=int(getattr(decoder,
+                                                "mesh_devices", 1))))
+                elif hasattr(decoder, "slots"):
+                    # fixed pool: one slot == one max_len-token block
+                    entry["kv_bytes"] = decoder.slots \
+                        * opsmem.kv_block_bytes(cfg, cfg.max_len)
+            used += entry["param_bytes"] + entry["kv_bytes"]
+            # aggregate by NAME, not name@version — the placement /
+            # affinity plane works in model names, and every resident
+            # version of a name occupies HBM toward that name's bill
+            agg = models.setdefault(rec.name,
+                                    {"param_bytes": 0, "kv_bytes": 0})
+            agg["param_bytes"] += entry["param_bytes"]
+            agg["kv_bytes"] += entry["kv_bytes"]
+        indexes = {name: int(store.report()["arena_bytes"])
+                   for name, store in stores.items()}
+        used += sum(indexes.values())
+        return {
+            "budget_bytes": budget_bytes,
+            "used_bytes": used,
+            # exact ratio, never rounded: a tiny model on a big budget
+            # must not report utilization 0.0 to the bin-packer
+            "utilization": (used / budget_bytes if budget_bytes else None),
+            "models": models,
+            "indexes": indexes,
+        }
+
     def metrics(self) -> Dict[str, Any]:
         return {"serving": self.stats.snapshot(),
                 "models": self.registry.describe(),
                 "health": self.model_health(),
-                "draining": self._draining}
+                "draining": self._draining,
+                "hbm": self.hbm_report()}
 
     def model_health(self) -> Dict[str, str]:
         """Per-model health: the breaker's verdict when the model has

@@ -56,6 +56,22 @@ def fleet_replicas_default() -> int:
     return int(envknob.get_int("DL4J_TPU_SERVE_FLEET_REPLICAS", 2))
 
 
+def goodbye_replica(board, fleet_dir: str, replica_id: str) -> None:
+    """The announced-departure goodbye in the SAFE order: unlink the
+    replica's addr JSON FIRST, then deregister from the board. The old
+    order (deregister -> remove addr) had a crash window that left a
+    permanently stale addr file — heartbeat entries self-heal via board
+    expiry, but addr files have no expiry, so a crash between the two
+    steps kept pointing the router at a dead socket forever (ISSUE 20
+    satellite). A crash in the new order leaves a board entry with no
+    addr, which expiry reaps. try/finally: the board goodbye still
+    lands even if the addr unlink raises."""
+    try:
+        remove_replica_addr(fleet_dir, replica_id)
+    finally:
+        board.deregister_worker(replica_id)
+
+
 class _ReplicaHandle:
     """One in-process replica: engine + membership heartbeat thread.
     The heartbeat is a SIDE thread (the training fleet's _Heartbeater
@@ -111,8 +127,7 @@ class _ReplicaHandle:
         self.alive = False
         self.engine.stop(drain=True)
         self.stop_heartbeat()
-        self.board.deregister_worker(self.rid)
-        remove_replica_addr(self.fleet_dir, self.rid)
+        goodbye_replica(self.board, self.fleet_dir, self.rid)
 
 
 class ServingFleet:
@@ -200,6 +215,23 @@ class ServingFleet:
             handle = self._handles.get(rid)
         if handle is not None and handle.alive:
             handle.kill()
+
+    def add_replica(self, role: str = "") -> str:
+        """Scale-UP enactment (the autoscaler DECIDES, this ENACTS —
+        the decide-vs-enact chaos discipline): spawn one fresh replica
+        on the lowest free rid slot. Deterministic: the rid is a pure
+        function of the current live membership, so a replayed decision
+        schedule names the same replicas."""
+        with self._lock:
+            live = {rid for rid, h in self._handles.items() if h.alive}
+        i = 0
+        while f"r{i}" in live:
+            i += 1
+        rid = f"r{i}"
+        if role:
+            self.roles[rid] = role
+        self._spawn(rid)
+        return rid
 
     def depart_replica(self, rid: str) -> None:
         """Announced departure (drain + goodbye) for one replica."""
@@ -297,8 +329,7 @@ def run_replica(*, fleet_dir: str, replica_id: str,
             board.heartbeat(replica_id)
             time.sleep(interval)
     finally:
-        board.deregister_worker(replica_id)
-        remove_replica_addr(fleet_dir, replica_id)
+        goodbye_replica(board, fleet_dir, replica_id)
 
 
 def main(argv=None) -> int:

@@ -50,16 +50,40 @@ Planes, and how they compose:
                A replica that fails warmup never serves the new version
                (registry guarantees its default did not move).
 
+  tenant       Per-tenant token buckets (ISSUE 20; serving/slo.py
+  quotas       ``TenantBucket`` over ``DL4J_TPU_SERVE_TENANT_QUOTAS``)
+               layered OVER the SLO classes at the same admission gate:
+               a metered tenant whose bucket is empty sheds with 429 +
+               Retry-After (seconds until one token refills) BEFORE it
+               can consume in-flight headroom, so one tenant's burst
+               never starves another tenant's admission. Unlisted
+               tenants (and untagged requests) are unmetered. Usage
+               rides ``router_stats`` (tenant_admitted / tenant_shed,
+               per tenant).
+  placement    A serving/placement.PlacementPlan (pushed by the
+  affinity     autoscaler via :meth:`set_placement`) makes routing
+               model-AWARE: a request naming a placed model only walks
+               the replicas that HOLD it; a placed model with zero
+               ready holders (or one that fit on no replica) is a LOUD
+               503 naming the model — never a silent wrong-replica 500.
+               Models the plan does not know stay fleet-routed.
+
 HTTP surface: POST /predict and /generate (proxied, same wire contract
 as the engine — streaming /generate chunks re-framed through), GET
 /health (200 iff >= 1 routable replica; per-replica states), GET
 /metrics (router ledger JSON; Prometheus via the central registry like
-the engine), GET /replicas, POST /rollout.
+the engine), GET /replicas (with per-replica HBM utilization scraped
+from the engines' AOT accounting), GET /signals (the autoscaler's
+machine-readable decision input: per-replica queue depth + ready/role,
+per-class p99 vs deadline, shed + tenant counters), GET /placement
+(the audited bin-packing plan), POST /rollout.
 
 Env knobs (ops/env.py): DL4J_TPU_SERVE_ROUTER_PORT (0 = ephemeral),
 DL4J_TPU_SERVE_REPLICA_FAILS (consecutive connect/5xx failures that
-eject a replica; 0 disables replica breakers). Fault injection is
-config-driven and never ambient: resilience/chaos.RouterChaosConfig.
+eject a replica; 0 disables replica breakers),
+DL4J_TPU_SERVE_TENANT_QUOTAS (per-tenant token buckets). Fault
+injection is config-driven and never ambient:
+resilience/chaos.RouterChaosConfig.
 """
 
 from __future__ import annotations
@@ -86,7 +110,11 @@ from deeplearning4j_tpu.serving.resilience import (
     BreakerOpenError,
     CircuitBreaker,
 )
-from deeplearning4j_tpu.serving.slo import parse_slo_classes
+from deeplearning4j_tpu.serving.slo import (
+    TenantBucket,
+    parse_slo_classes,
+    parse_tenant_quotas,
+)
 
 
 def replica_fails_default() -> int:
@@ -178,6 +206,18 @@ class RouterStats:
         self.breaker_closes = 0      # half-open probes that re-admitted
         self.breaker_probes = 0
         self.fast_fails_503 = 0      # candidates skipped by open breaker
+        # tenant-quota plane (ISSUE 20): admissions/sheds per metered
+        # tenant — the fairness evidence (one tenant's 429 burst beside
+        # another tenant's untouched admissions)
+        self.tenant_admitted: Dict[str, int] = {}
+        self.tenant_shed: Dict[str, int] = {}
+        # placement-affinity plane: loud 503s for models with zero
+        # ready holders (the never-silently-misroute contract)
+        self.affinity_503 = 0
+        # per-SLO-class latency rings: the autoscaler's p99-vs-deadline
+        # pressure signal (the global ring cannot say WHICH class is
+        # blowing its deadline)
+        self._class_lat: Dict[str, List[float]] = {}
 
     # -- recording --------------------------------------------------------
     def record_request(self) -> None:
@@ -190,6 +230,22 @@ class RouterStats:
             self._lat.append(float(seconds))
             if len(self._lat) > self._window:
                 del self._lat[:len(self._lat) - self._window]
+
+    def record_class_latency(self, slo_class: str, seconds: float) -> None:
+        with self._lock:
+            ring = self._class_lat.setdefault(str(slo_class), [])
+            ring.append(float(seconds))
+            if len(ring) > self._window:
+                del ring[:len(ring) - self._window]
+
+    def record_tenant(self, tenant: str, admitted: bool) -> None:
+        with self._lock:
+            ledger = self.tenant_admitted if admitted else self.tenant_shed
+            ledger[tenant] = ledger.get(tenant, 0) + 1
+
+    def record_affinity_503(self) -> None:
+        with self._lock:
+            self.affinity_503 += 1
 
     def record_retry(self) -> None:
         with self._lock:
@@ -267,6 +323,24 @@ class RouterStats:
             "count": int(lat.size),
         }
 
+    def per_class_latency_ms(self) -> Dict[str, Dict[str, Optional[float]]]:
+        """p50/p99 per SLO class — /signals' pressure input."""
+        with self._lock:
+            # graftlint: disable=host-sync-under-lock -- host-side float rings only; no device buffer ever enters them
+            rings = {name: np.asarray(ring, np.float64)
+                     for name, ring in self._class_lat.items()}
+        out: Dict[str, Dict[str, Optional[float]]] = {}
+        for name, lat in sorted(rings.items()):
+            if lat.size == 0:
+                out[name] = {"p50": None, "p99": None, "count": 0}
+                continue
+            out[name] = {
+                "p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+                "count": int(lat.size),
+            }
+        return out
+
     def snapshot(self) -> Dict[str, Any]:
         lat = self.latency_ms()
         with self._lock:
@@ -289,8 +363,12 @@ class RouterStats:
                 "breaker_closes": self.breaker_closes,
                 "breaker_probes": self.breaker_probes,
                 "fast_fails_503": self.fast_fails_503,
+                "tenant_admitted": dict(self.tenant_admitted),
+                "tenant_shed": dict(self.tenant_shed),
+                "affinity_503": self.affinity_503,
             }
         out["latency_ms"] = lat
+        out["per_class_latency_ms"] = self.per_class_latency_ms()
         return out
 
 
@@ -305,9 +383,17 @@ class _Replica:
         self.breaker = breaker
         self.role = str(role)  # '' both planes | 'prefill' | 'decode'
         self.ready = True  # optimistic until the first probe says no
+        # cordoned: routing-fenced ahead of an announced departure
+        # (scale-down) so new traffic never races the drain's first
+        # instants — the readiness poll would take up to poll_s to
+        # notice the 503-when-draining flip, and a relayed 503 in that
+        # window would be a failed admitted request. A NEW incarnation
+        # (re-published addr) re-joins as a fresh _Replica, uncordoned.
+        self.cordoned = False
 
     def describe(self) -> Dict[str, Any]:
         return {"url": self.url, "ready": self.ready, "role": self.role,
+                "cordoned": self.cordoned,
                 "breaker": self.breaker.snapshot()}
 
 
@@ -321,6 +407,24 @@ class FleetRouterError(RuntimeError):
 class FleetOverloadError(RuntimeError):
     """Fleet-wide SLO shed: the in-flight cap left no headroom for this
     request's class. 429 + Retry-After."""
+
+    retry_after_s = 1.0
+
+
+class TenantQuotaError(FleetOverloadError):
+    """A metered tenant's token bucket is empty: shed THIS tenant with
+    429 + Retry-After (seconds until one token refills) while every
+    other tenant's admission proceeds untouched (ISSUE 20)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class ModelUnplacedError(FleetRouterError):
+    """The placement plan knows this model but zero READY replicas hold
+    it (or it fit on no replica at all): a loud 503 naming the model —
+    never a silent wrong-replica 500 (ISSUE 20 affinity contract)."""
 
 
 class _PassThrough(Exception):
@@ -358,6 +462,8 @@ class FleetRouter:
                  request_timeout_s: Optional[float] = None,
                  queue_cap: Optional[int] = None,
                  slo_classes: Optional[str] = None,
+                 tenant_quotas: Optional[str] = None,
+                 tenant_now_fn: Optional[Callable[[], float]] = None,
                  chaos=None,
                  on_kill: Optional[Callable[[str], None]] = None) -> None:
         self.replica_fails = int(replica_fails if replica_fails is not None
@@ -374,6 +480,20 @@ class FleetRouter:
         self.slo_classes = parse_slo_classes(
             slo_classes if slo_classes is not None
             else envknob.raw("DL4J_TPU_SERVE_SLO_CLASSES", ""))
+        # per-tenant token buckets (ISSUE 20): built once at router
+        # construction from the spec; tenant_now_fn injects a test clock
+        # (deterministic fairness verdicts — the TenantBucket contract)
+        quota_spec = (tenant_quotas if tenant_quotas is not None
+                      else envknob.raw("DL4J_TPU_SERVE_TENANT_QUOTAS", ""))
+        bucket_kw = ({"now_fn": tenant_now_fn}
+                     if tenant_now_fn is not None else {})
+        self.tenant_buckets: Dict[str, TenantBucket] = {
+            q.name: TenantBucket(q, **bucket_kw)
+            for q in parse_tenant_quotas(quota_spec)}
+        # placement plan (serving/placement.py), pushed by the
+        # autoscaler; None = every model everywhere (pre-placement
+        # routing, byte-unchanged)
+        self._placement = None
         self.chaos = chaos
         self.on_kill = on_kill
         self.stats = RouterStats()
@@ -517,7 +637,24 @@ class FleetRouter:
         """Fleet-wide SLO shed: class priority p of n gets the in-flight
         headroom ``cap * (n - p) / n`` — the highest class keeps the full
         cap while lower classes shed progressively earlier. Returns the
-        class name; the caller MUST pair with :meth:`_release`."""
+        class name; the caller MUST pair with :meth:`_release`.
+
+        Tenant quotas gate FIRST (ISSUE 20): a metered tenant with an
+        empty bucket is shed before it can consume in-flight headroom,
+        so its burst never displaces another tenant's admission. The
+        shed carries the bucket's own refill time as Retry-After."""
+        tenant = (payload.get("tenant") if isinstance(payload, dict)
+                  else None)
+        bucket = (self.tenant_buckets.get(tenant)
+                  if isinstance(tenant, str) else None)
+        if bucket is not None:
+            ok, retry_s = bucket.try_take()
+            self.stats.record_tenant(tenant, ok)
+            if not ok:
+                raise TenantQuotaError(
+                    f"tenant {tenant!r} quota exhausted "
+                    f"({bucket.quota.rate_per_s}/s, burst "
+                    f"{bucket.quota.burst})", retry_after_s=retry_s)
         name, priority = self._class_of(payload)
         n = max(1, len(self.slo_classes))
         cap = max(1, math.ceil(self.queue_cap * (n - priority) / n))
@@ -540,8 +677,26 @@ class FleetRouter:
             self._inflight -= 1
 
     # -- routing -----------------------------------------------------------
-    def _candidates(self, decode_only: bool = False) -> List[_Replica]:
+    def _candidates(self, decode_only: bool = False,
+                    model: Optional[str] = None) -> List[_Replica]:
         reps = self._snapshot()
+        plan = self._placement
+        if plan is not None and isinstance(model, str) and model \
+                and model in plan.models():
+            # model-affinity routing (ISSUE 20): a PLACED model only
+            # walks the replicas that hold it; zero ready holders (or
+            # unplaced — it fit nowhere) is a LOUD 503 naming the
+            # model, never a silent wrong-replica answer. Models the
+            # plan does not know keep the fleet-wide walk.
+            holders = set(plan.replicas_of(model))
+            reps = [r for r in reps if r.rid in holders]
+            if not any(r.ready for r in reps):
+                self.stats.record_affinity_503()
+                where = (f"holders {sorted(holders)} not ready" if holders
+                         else "UNPLACED — fits no replica's HBM budget")
+                raise ModelUnplacedError(
+                    f"model {model!r} is placed on zero ready replicas "
+                    f"({where})")
         if decode_only:
             # role-aware /generate dispatch (ISSUE 18): a prefill-role
             # replica exists to run /prefill, not to hold decode lanes —
@@ -554,7 +709,7 @@ class FleetRouter:
                 reps = decode
         ready = []
         for rep in reps:
-            if rep.ready:
+            if rep.ready and not rep.cordoned:
                 ready.append(rep)
             else:
                 self.stats.record_not_ready_skip()
@@ -589,18 +744,24 @@ class FleetRouter:
         body) of the winning response; raises FleetRouterError when no
         candidate answered."""
         payload = _parse_json(body)
-        self._admit(payload)
+        cls = self._admit(payload)
+        start = time.monotonic()
         try:
             with obs_trace.span("fleet.route", kind="predict"):
-                return self._walk_predict(body)
+                result = self._walk_predict(body, payload.get("model"))
+            if result[0] < 400:
+                self.stats.record_class_latency(
+                    cls, time.monotonic() - start)
+            return result
         finally:
             self._release()
             self._after_proxy()
 
-    def _walk_predict(self, body: bytes) -> tuple:
+    def _walk_predict(self, body: bytes,
+                      model: Optional[str] = None) -> tuple:
         last_response: Optional[tuple] = None
         tried = 0
-        for rep in self._candidates():
+        for rep in self._candidates(model=model):
             try:
                 rep.breaker.check()
             except BreakerOpenError:
@@ -726,18 +887,24 @@ class FleetRouter:
         non-streamed by this method's caller contract; the HTTP layer
         uses :meth:`proxy_generate_stream` for ``"stream": true``."""
         payload = _parse_json(body)
-        self._admit(payload)
+        cls = self._admit(payload)
+        start = time.monotonic()
         try:
             with obs_trace.span("fleet.route", kind="generate"):
-                return self._walk_generate(body)
+                result = self._walk_generate(body, payload.get("model"))
+            if result[0] < 400:
+                self.stats.record_class_latency(
+                    cls, time.monotonic() - start)
+            return result
         finally:
             self._release()
             self._after_proxy()
 
-    def _walk_generate(self, body: bytes) -> tuple:
+    def _walk_generate(self, body: bytes,
+                       model: Optional[str] = None) -> tuple:
         last_response: Optional[tuple] = None
         prime = self._prefill_payload(body)
-        for rep in self._candidates(decode_only=True):
+        for rep in self._candidates(decode_only=True, model=model):
             try:
                 rep.breaker.check()
             except BreakerOpenError:
@@ -892,8 +1059,102 @@ class FleetRouter:
             pass  # the replica died mid-rollback; membership will notice
 
     # -- introspection -----------------------------------------------------
-    def describe_replicas(self) -> Dict[str, Any]:
-        return {rep.rid: rep.describe() for rep in self._snapshot()}
+    def describe_replicas(self, hbm: bool = False) -> Dict[str, Any]:
+        """Per-replica table. ``hbm=True`` (the GET /replicas shape,
+        ISSUE 20 satellite) also scrapes each READY replica's
+        engine-side AOT HBM accounting (engine.hbm_report — params +
+        KV arena + ANN arenas vs DL4J_TPU_HBM_GB, tunnel-free); kept
+        off the health() path, which must stay scrape-free."""
+        out = {rep.rid: rep.describe() for rep in self._snapshot()}
+        if hbm:
+            for rep in self._snapshot():
+                if not rep.ready:
+                    continue
+                try:
+                    status, _, data = _http_call(
+                        rep.url, "GET", "/metrics",
+                        timeout=self.probe_timeout_s)
+                except OSError:
+                    continue  # readiness/board will notice; not a vote
+                if status == 200:
+                    out[rep.rid]["hbm"] = json.loads(data).get("hbm")
+        return out
+
+    def signals(self) -> Dict[str, Any]:
+        """The autoscaler's one-endpoint decision input (GET /signals):
+        per-replica queue depth (scraped from each ready engine's
+        serving_stats) + ready/role/breaker state, the router's
+        in-flight count, per-class p99 beside each class's deadline,
+        and the shed + tenant ledgers. Scrape failures leave a
+        replica's queue_depth None — visible, never a breaker vote."""
+        replicas: Dict[str, Any] = {}
+        queue_total = 0
+        for rep in self._snapshot():
+            entry = {"ready": rep.ready, "role": rep.role,
+                     "cordoned": rep.cordoned,
+                     "breaker": rep.breaker.snapshot()["state"],
+                     "queue_depth": None}
+            if rep.ready:
+                try:
+                    status, _, data = _http_call(
+                        rep.url, "GET", "/metrics",
+                        timeout=self.probe_timeout_s)
+                    if status == 200:
+                        serving = json.loads(data).get("serving", {})
+                        entry["queue_depth"] = int(
+                            serving.get("queue_depth", 0))
+                        queue_total += entry["queue_depth"]
+                except (OSError, ValueError):
+                    pass
+            replicas[rep.rid] = entry
+        snap = self.stats.snapshot()
+        with self._lock:
+            inflight = self._inflight
+        return {
+            "replicas": replicas,
+            "ready_replicas": sorted(
+                rid for rid, e in replicas.items() if e["ready"]),
+            "queue_depth": queue_total,
+            "inflight": inflight,
+            "shed_total": snap["fleet_429"],
+            "shed_by_class": snap["shed_by_class"],
+            "per_class_latency_ms": snap["per_class_latency_ms"],
+            "slo_classes": [{"name": c.name, "deadline_s": c.deadline_s}
+                            for c in self.slo_classes],
+            "tenant_admitted": snap["tenant_admitted"],
+            "tenant_shed": snap["tenant_shed"],
+            "affinity_503": snap["affinity_503"],
+        }
+
+    def cordon(self, rid: str) -> None:
+        """Fence a replica out of routing NOW — the step before an
+        announced departure (the autoscaler's scale-down enactment).
+        Admitted/in-flight work on the replica is untouched (the drain
+        answers it); only NEW routing skips it. Unknown rids are a
+        no-op (the replica may already have left)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+        if rep is not None:
+            rep.cordoned = True
+            obs_journal.event("fleet.cordon", replica=rid)
+
+    # -- placement (serving/placement.py, pushed by the autoscaler) --------
+    def set_placement(self, plan) -> None:
+        """Adopt a PlacementPlan: from now on requests naming a placed
+        model only walk its holders (None clears back to fleet-wide
+        routing). Journaled — the placement timeline is part of the
+        fleet's flight-recorder story."""
+        self._placement = plan
+        if plan is not None:
+            obs_journal.event("fleet.placement",
+                              models=len(plan.models()),
+                              unplaced=len(plan.unplaced))
+
+    def placement_report(self) -> Dict[str, Any]:
+        plan = self._placement
+        if plan is None:
+            return {"placement": None}
+        return {"placement": plan.describe()}
 
     def health(self) -> tuple:
         """(http_code, body): 200 iff at least one replica is routable
@@ -953,7 +1214,11 @@ class FleetRouter:
                     code, body = router.health()
                     self._send(code, body)
                 elif path == "/replicas":
-                    self._send(200, router.describe_replicas())
+                    self._send(200, router.describe_replicas(hbm=True))
+                elif path == "/signals":
+                    self._send(200, router.signals())
+                elif path == "/placement":
+                    self._send(200, router.placement_report())
                 elif path == "/metrics":
                     accept = self.headers.get("Accept", "")
                     if ("format=prometheus" in self.path
@@ -997,8 +1262,11 @@ class FleetRouter:
                         self._send(404, {"error": "not found"})
                         return
                 except FleetOverloadError as e:
+                    # RFC 9110 delta-seconds is an integer: round the
+                    # bucket's fractional refill time UP to 1
                     self._send(429, {"error": f"{e}"},
-                               headers={"Retry-After": "1"})
+                               headers={"Retry-After": str(max(
+                                   1, math.ceil(e.retry_after_s)))})
                     return
                 except FleetRouterError as e:
                     self._send(503, {"error": f"{e}"},
@@ -1015,26 +1283,37 @@ class FleetRouter:
             def _stream_generate(self, body: bytes):
                 """Streamed /generate: committed to ONE replica once the
                 response begins; chunks re-framed through verbatim."""
+                payload = _parse_json(body)
                 try:
-                    router._admit(_parse_json(body))
+                    cls = router._admit(payload)
                 except FleetOverloadError as e:
                     self._send(429, {"error": f"{e}"},
-                               headers={"Retry-After": "1"})
+                               headers={"Retry-After": str(max(
+                                   1, math.ceil(e.retry_after_s)))})
                     return
                 try:
-                    router._stream_through(self, body)
+                    router._stream_through(self, body, slo_class=cls,
+                                           model=payload.get("model"))
                 finally:
                     router._release()
                     router._after_proxy()
 
         return Handler
 
-    def _stream_through(self, handler, body: bytes) -> None:
+    def _stream_through(self, handler, body: bytes,
+                        slo_class: Optional[str] = None,
+                        model: Optional[str] = None) -> None:
         """Proxy a streaming /generate to the first replica that ACCEPTS
         it (connect + response headers); after that the stream is
         committed (a half-relayed token stream cannot be replayed)."""
         prime = self._prefill_payload(body)
-        for rep in self._candidates(decode_only=True):
+        try:
+            candidates = self._candidates(decode_only=True, model=model)
+        except FleetRouterError as e:
+            handler._send(503, {"error": f"{e}"},
+                          headers={"Retry-After": "1"})
+            return
+        for rep in candidates:
             try:
                 rep.breaker.check()
             except BreakerOpenError:
@@ -1091,6 +1370,9 @@ class FleetRouter:
                 handler.wfile.flush()
                 rep.breaker.record_success()
                 self.stats.record_proxied(time.monotonic() - start)
+                if slo_class is not None:
+                    self.stats.record_class_latency(
+                        slo_class, time.monotonic() - start)
             finally:
                 conn.close()
             return

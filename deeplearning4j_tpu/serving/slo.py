@@ -18,12 +18,22 @@ Spec format (``DL4J_TPU_SERVE_SLO_CLASSES``): ``name:deadline_s`` pairs,
 comma-separated, highest priority first — e.g. ``interactive:5,batch:60``.
 Empty spec = one implicit ``default`` class at the engine's request
 timeout, which reproduces the pre-SLO FIFO scheduler exactly.
+
+Tenant quotas (ISSUE 20) layer OVER the classes: a class says how urgent
+admitted work is; a tenant bucket says how much of the admission budget
+one payer may consume. ``DL4J_TPU_SERVE_TENANT_QUOTAS``
+(``name:rate_per_s[:burst],...``) builds one token bucket per configured
+tenant — an exhausted bucket sheds THAT tenant with 429 + Retry-After
+while every other tenant's admission is untouched; unlisted tenants are
+unmetered (quotas are an opt-in metering plane, not an allow-list).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from dataclasses import dataclass
-from typing import List
+from typing import Callable, List, Tuple
 
 
 @dataclass(frozen=True)
@@ -71,3 +81,90 @@ def parse_slo_classes(spec: str) -> List[SLOClass]:
 def default_classes(request_timeout_s: float) -> List[SLOClass]:
     """The implicit single-class policy (pre-SLO behavior)."""
     return [SLOClass("default", float(request_timeout_s), 0)]
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    name: str
+    rate_per_s: float  # sustained admissions per second (refill rate)
+    burst: float       # bucket capacity (peak back-to-back admissions)
+
+
+def parse_tenant_quotas(spec: str) -> List[TenantQuota]:
+    """``"acme:10,free:2:5"`` -> [TenantQuota, ...].
+
+    ``name:rate_per_s`` or ``name:rate_per_s:burst``; burst defaults to
+    ``max(1, rate_per_s)`` (one second of sustained rate, never below a
+    single request). Raises ValueError on malformed entries — a typo'd
+    quota config must fail at router construction, not silently admit
+    a tenant unmetered (the parse_slo_classes discipline)."""
+    out: List[TenantQuota] = []
+    spec = (spec or "").strip()
+    if not spec:
+        return out
+    seen = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = [f.strip() for f in part.split(":")]
+        if len(fields) not in (2, 3) or not fields[0]:
+            raise ValueError(
+                f"bad tenant quota {part!r}: expected "
+                "name:rate_per_s[:burst]")
+        name = fields[0]
+        if name in seen:
+            raise ValueError(f"duplicate tenant quota {name!r}")
+        try:
+            rate = float(fields[1])
+            burst = (float(fields[2]) if len(fields) == 3
+                     else max(1.0, rate))
+        except ValueError:
+            raise ValueError(
+                f"bad tenant quota numbers in {part!r}") from None
+        if rate <= 0 or burst < 1:
+            raise ValueError(
+                f"tenant quota {name!r} needs rate > 0 and burst >= 1")
+        seen.add(name)
+        out.append(TenantQuota(name, rate, burst))
+    return out
+
+
+class TenantBucket:
+    """One tenant's token bucket: ``burst`` capacity refilled at
+    ``rate_per_s``, one token per admitted request.
+
+    The clock is injectable (``now_fn``) so tests and the bench leg can
+    drive admission verdicts deterministically — the scale-decision
+    replay discipline applied to fairness. Thread-safe: the router's
+    admission gate calls ``try_take`` from concurrent handler threads.
+    """
+
+    def __init__(self, quota: TenantQuota,
+                 now_fn: Callable[[], float] = time.monotonic) -> None:
+        self.quota = quota
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._tokens = float(quota.burst)
+        self._last: float = None  # first take starts the refill clock
+
+    def try_take(self) -> Tuple[bool, float]:
+        """(admitted, retry_after_s): consume one token if available,
+        else the seconds until the bucket refills to one token — the
+        Retry-After the 429 carries."""
+        with self._lock:
+            now = self._now()
+            if self._last is not None and now > self._last:
+                self._tokens = min(
+                    self.quota.burst,
+                    self._tokens + (now - self._last)
+                    * self.quota.rate_per_s)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True, 0.0
+            return False, (1.0 - self._tokens) / self.quota.rate_per_s
+
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens

@@ -32,7 +32,7 @@ EXPECTED = [
     "remat_memory", "char_rnn", "word2vec_sgns", "transformer_lm",
     "resnet50", "resnet50_bf16", "transformer_lm_big", "flash_attention",
     "ring_attention", "lstm_kernel", "north_star", "serving_throughput",
-    "serving_resilience", "serving_decode", "serving_fleet",
+    "serving_resilience", "serving_decode", "serving_fleet", "autoscale",
     "decode_amortize", "serving_mesh", "checkpoint_overhead",
     "input_pipeline",
     "elastic_dp", "online_loop", "lowprec", "retrieval", "obs_overhead",

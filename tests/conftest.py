@@ -131,6 +131,12 @@ _QUICK_FILES = {
     # the prefill->decode handoff, knob/ledger registration — tiny LMs
     # on the virtual CPU mesh, ~40s
     "test_serving_mesh.py",
+    # autoscaling plane (ISSUE 20): deterministic scale-decision replay,
+    # chaos load wave -> scale-up -> scale-down racing live /predict +
+    # /generate with zero failed admitted requests, tenant-bucket
+    # fairness, FFD placement + affinity 503 loudness, goodbye ordering,
+    # knob/ledger/leg registration — tiny nets, ~40s
+    "test_autoscale.py",
 }
 # float64 recurrent gradchecks cost ~2 min alone — full-suite only; the
 # attention/MoE/BERT checks (VERDICT r5 ask #6) cost ~80s together and
